@@ -1,0 +1,27 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; all sharding/collective
+tests run on XLA's host platform with 8 virtual devices
+(--xla_force_host_platform_device_count), per the multi-chip test strategy.
+"""
+
+import os
+import sys
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " " + _FLAG).strip()
+
+# Force the CPU platform before any backend initialization. The environment
+# may pin JAX_PLATFORMS to a TPU plugin (axon); jax.config wins if applied
+# before first device query.
+os.environ["JAX_PLATFORMS"] = "cpu"
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # jax missing or already initialized — tests will surface it
+    pass
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,92 @@
+"""API type round-trip + helper tests (reference: util_test.go, types)."""
+
+import datetime as dt
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.serde import parse_time, snake_to_camel
+from tf_operator_tpu.api.types import (
+    Container,
+    JobCondition,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    ReplicaStatus,
+    ReplicaType,
+    TPUJob,
+    gen_general_name,
+    is_chief_or_master,
+    is_evaluator,
+    is_worker,
+)
+from tf_operator_tpu import testutil
+
+
+def test_snake_to_camel():
+    assert snake_to_camel("replica_specs") == "replicaSpecs"
+    assert snake_to_camel("ttl_seconds_after_finished") == "ttlSecondsAfterFinished"
+    assert snake_to_camel("name") == "name"
+
+
+def test_gen_general_name():
+    # Reference contract {job}-{rtype}-{index} (common/util.go:47-50) —
+    # pod_names_validation_tests.py asserts this naming e2e.
+    assert gen_general_name("mnist", "Worker", 3) == "mnist-worker-3"
+    assert gen_general_name("j", ReplicaType.PS, 0) == "j-ps-0"
+
+
+def test_role_helpers():
+    assert is_chief_or_master("chief")
+    assert is_chief_or_master("Master")
+    assert not is_chief_or_master("worker")
+    assert is_worker("Worker")
+    assert is_evaluator("evaluator")
+
+
+def test_job_round_trip():
+    job = testutil.new_tpujob(worker=4, ps=2, accelerator="v5p-32")
+    job.status.replica_statuses["worker"] = ReplicaStatus(active=3, failed=1)
+    job.status.conditions.append(JobCondition(
+        type="Created", status="True", reason="JobCreated",
+        last_update_time=dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)))
+    wire = job.to_dict()
+    assert wire["apiVersion"] == constants.API_VERSION
+    assert wire["spec"]["replicaSpecs"]["worker"]["replicas"] == 4
+    assert wire["spec"]["slice"]["accelerator"] == "v5p-32"
+    assert wire["status"]["conditions"][0]["lastUpdateTime"] == "2026-01-01T00:00:00Z"
+
+    back = TPUJob.from_dict(wire)
+    assert back.spec.replica_specs["worker"].replicas == 4
+    assert back.status.replica_statuses["worker"].active == 3
+    assert back.status.conditions[0].last_update_time.year == 2026
+    assert back.to_dict() == wire
+
+
+def test_pod_round_trip():
+    job = testutil.new_tpujob(worker=1)
+    pod = testutil.new_pod(job, "worker", 0, phase=PodPhase.FAILED, exit_code=137)
+    wire = pod.to_dict()
+    back = Pod.from_dict(wire)
+    assert back.status.phase == "Failed"
+    assert back.status.container_statuses[0].exit_code == 137
+    assert back.metadata.controller_ref().uid == job.metadata.uid
+    assert back.metadata.labels[constants.LABEL_REPLICA_INDEX] == "0"
+
+
+def test_deepcopy_isolation():
+    job = testutil.new_tpujob(worker=2)
+    cp = job.deepcopy()
+    cp.spec.replica_specs["worker"].replicas = 99
+    assert job.spec.replica_specs["worker"].replicas == 2
+
+
+def test_parse_time_accepts_offsets():
+    t = parse_time("2026-07-29T10:00:00+02:00")
+    assert t.utcoffset() == dt.timedelta(hours=2)
+
+
+def test_container_defaults():
+    c = Container()
+    assert c.name == constants.DEFAULT_CONTAINER_NAME
+    m = ObjectMeta()
+    assert m.namespace == "default"
+    assert m.controller_ref() is None

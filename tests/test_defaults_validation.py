@@ -1,0 +1,136 @@
+"""Defaulting + validation tests (reference: defaults_test.go, validation_test.go)."""
+
+import pytest
+
+from tf_operator_tpu.api import constants, set_defaults, validate_job
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    Container,
+    PodSpec,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    TPUJob,
+    TPUJobSpec,
+    ObjectMeta,
+)
+from tf_operator_tpu.api.validation import ValidationError
+from tf_operator_tpu import testutil
+
+
+def test_defaults_fill_replicas_and_restart_policy():
+    job = testutil.new_tpujob(worker=1)
+    job.spec.replica_specs["worker"].replicas = None
+    job.spec.replica_specs["worker"].restart_policy = ""
+    set_defaults(job)
+    assert job.spec.replica_specs["worker"].replicas == 1
+    assert job.spec.replica_specs["worker"].restart_policy == RestartPolicy.NEVER
+    assert job.spec.run_policy.clean_pod_policy == CleanPodPolicy.RUNNING
+
+
+def test_defaults_inject_port():
+    # Reference setDefaultPort (defaults.go:36-58).
+    job = testutil.new_tpujob(worker=1)
+    c = job.spec.replica_specs["worker"].template.spec.containers[0]
+    assert constants.DEFAULT_PORT_NAME not in c.ports
+    set_defaults(job)
+    assert c.ports[constants.DEFAULT_PORT_NAME] == constants.DEFAULT_PORT
+
+
+def test_defaults_preserve_existing_port():
+    job = testutil.new_tpujob(worker=1)
+    c = job.spec.replica_specs["worker"].template.spec.containers[0]
+    c.ports[constants.DEFAULT_PORT_NAME] = 9999
+    set_defaults(job)
+    assert c.ports[constants.DEFAULT_PORT_NAME] == 9999
+
+
+def test_defaults_normalize_replica_type_keys():
+    # Reference setTypeNamesToCamelCase (defaults.go:70-89); we lowercase.
+    job = testutil.new_tpujob()
+    job.spec.replica_specs = {"Worker": testutil.new_replica_spec(2)}
+    set_defaults(job)
+    assert list(job.spec.replica_specs) == ["worker"]
+    assert job.spec.replica_specs["worker"].replicas == 2
+
+
+def test_validate_ok():
+    job = testutil.new_tpujob(worker=2, ps=1, chief=1, accelerator="v5p-32")
+    set_defaults(job)
+    validate_job(job)  # should not raise
+
+
+def test_validate_empty_spec():
+    job = TPUJob(metadata=ObjectMeta(name="j"))
+    with pytest.raises(ValidationError, match="at least one replica type"):
+        validate_job(job)
+
+
+def test_validate_no_default_container():
+    # Reference: "There is no container named tensorflow" (validation.go:52-57).
+    job = testutil.new_tpujob(worker=1)
+    job.spec.replica_specs["worker"].template.spec.containers[0].name = "other"
+    with pytest.raises(ValidationError, match="no container named"):
+        validate_job(job)
+
+
+def test_validate_empty_containers():
+    job = testutil.new_tpujob(worker=1)
+    job.spec.replica_specs["worker"].template = PodTemplateSpec(spec=PodSpec())
+    with pytest.raises(ValidationError, match="containers must not be empty"):
+        validate_job(job)
+
+
+def test_validate_two_chiefs():
+    # Reference: more than 1 chief/master (validation.go:58-64).
+    job = testutil.new_tpujob(worker=1, chief=1, master=1)
+    with pytest.raises(ValidationError, match="at most one chief/master"):
+        validate_job(job)
+
+
+def test_validate_bad_accelerator_and_topology():
+    job = testutil.new_tpujob(worker=1)
+    job.spec.slice.accelerator = "h100-8"
+    with pytest.raises(ValidationError, match="accelerator"):
+        validate_job(job)
+    job.spec.slice.accelerator = "v5p-8"
+    job.spec.slice.topology = "2x-3"
+    with pytest.raises(ValidationError, match="topology"):
+        validate_job(job)
+
+
+def test_validate_bad_name():
+    job = testutil.new_tpujob(worker=1, name="Bad_Name")
+    with pytest.raises(ValidationError, match="RFC-1123"):
+        validate_job(job)
+
+
+def test_defaults_reject_case_duplicate_keys():
+    job = testutil.new_tpujob()
+    job.spec.replica_specs = {"Worker": testutil.new_replica_spec(1),
+                              "worker": testutil.new_replica_spec(2)}
+    with pytest.raises(ValidationError, match="duplicate replica type"):
+        set_defaults(job)
+
+
+def test_rfc3339_subsecond_round_trip():
+    import datetime as dt
+    from tf_operator_tpu.api.types import JobStatus
+    st = JobStatus(start_time=dt.datetime(2026, 1, 1, 0, 0, 0, 500000,
+                                          tzinfo=dt.timezone.utc))
+    back = JobStatus.from_dict(st.to_dict())
+    assert back.start_time == st.start_time
+
+
+def test_validate_collects_multiple_errors():
+    job = testutil.new_tpujob(worker=1)
+    job.spec.replica_specs["worker"].restart_policy = "Sometimes"
+    job.spec.replica_specs["gpu"] = testutil.new_replica_spec(1)
+    job.spec.run_policy.backoff_limit = -1
+    with pytest.raises(ValidationError) as ei:
+        validate_job(job)
+    msgs = ei.value.errors
+    assert len(msgs) >= 3
+    assert any("restartPolicy" in m for m in msgs)
+    assert any("unknown replica type" in m for m in msgs)
+    assert any("backoffLimit" in m for m in msgs)

@@ -1,0 +1,17 @@
+"""tf_operator_tpu — a TPU-native distributed-job orchestration framework.
+
+A ground-up rebuild of the capabilities of the Kubeflow TF-Operator
+(reference: /root/reference, a Go Kubernetes operator) designed TPU-first:
+
+- A declarative ``TPUJob`` API (replica roles, slice topology, run policy)
+  mirroring the TFJob CRD surface (reference ``pkg/apis/tensorflow/v1/types.go``).
+- A generic level-triggered reconcile engine with expectations, adoption and
+  index-stable replica identity (reference ``vendor/.../kubeflow/common``).
+- TPU cluster bootstrap: slice topology -> ICI mesh axes -> per-worker env
+  (``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES``/coordinator), replacing the
+  reference's TF_CONFIG rendering (``pkg/controller.v1/tensorflow/tensorflow.go``).
+- An in-repo JAX/pjit/pallas training harness (data/tensor/expert/context
+  parallel model families) that the reference delegated to user containers.
+"""
+
+from tf_operator_tpu.version import __version__, GIT_SHA  # noqa: F401

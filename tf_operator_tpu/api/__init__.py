@@ -1,0 +1,42 @@
+"""TPUJob API: types, constants, defaulting, validation.
+
+Reference parity: pkg/apis/tensorflow/{v1,validation} plus the shared
+kubeflow/common/pkg/apis/common/v1 types.
+"""
+
+from tf_operator_tpu.api import constants  # noqa: F401
+from tf_operator_tpu.api.defaults import set_defaults  # noqa: F401
+from tf_operator_tpu.api.types import (  # noqa: F401
+    CleanPodPolicy,
+    ConditionStatus,
+    Container,
+    Endpoint,
+    EndpointSpec,
+    JobCondition,
+    JobConditionType,
+    JobStatus,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaStatus,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    SliceGroup,
+    SliceGroupSpec,
+    SuccessPolicy,
+    TPUJob,
+    TPUJobSpec,
+    TPUSliceSpec,
+    gen_general_name,
+    is_chief_or_master,
+    is_evaluator,
+    is_worker,
+)
+from tf_operator_tpu.api.validation import ValidationError, validate_job  # noqa: F401

@@ -1,0 +1,55 @@
+"""API-group constants for the TPUJob kind.
+
+Reference parity: pkg/apis/tensorflow/v1/constants.go:21-34 and
+register.go:33-74 define group "kubeflow.org", kind "TFJob", default
+container "tensorflow" and default port "tfjob-port"=2222. The TPU-native
+framework keeps the same shape with TPU-appropriate values.
+"""
+
+# API group/version/kind (reference: pkg/apis/tensorflow/v1/register.go:33-44).
+GROUP = "tpu-operator.dev"
+VERSION = "v1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "TPUJob"
+PLURAL = "tpujobs"
+SINGULAR = "tpujob"
+# Fully-qualified resource name, analog of "tfjobs.kubeflow.org".
+CRD_NAME = f"{PLURAL}.{GROUP}"
+
+# The container that receives cluster-bootstrap env injection.
+# Reference: DefaultContainerName = "tensorflow" (constants.go:24).
+DEFAULT_CONTAINER_NAME = "jax"
+
+# Named port on which replicas rendezvous. The reference used the TF gRPC
+# port 2222 ("tfjob-port", constants.go:27-31); TPU workers conventionally
+# expose the libtpu worker port 8470.
+DEFAULT_PORT_NAME = "tpujob-port"
+DEFAULT_PORT = 8470
+
+# Port the jax.distributed coordination service listens on (process 0).
+# No reference analog — TF_CONFIG needed no coordinator; JAX does.
+DEFAULT_COORDINATOR_PORT = 8476
+
+# Env var overriding the namespace the operator watches.
+# Reference: EnvKubeflowNamespace (constants.go:34).
+ENV_OPERATOR_NAMESPACE = "TPU_OPERATOR_NAMESPACE"
+
+# Env var appended to replica DNS names, for clusters with a non-default
+# domain. Reference: EnvCustomClusterDomain (tensorflow.go:30-33).
+ENV_CUSTOM_CLUSTER_DOMAIN = "CUSTOM_CLUSTER_DOMAIN"
+
+# Well-known labels stamped on every pod/endpoint the engine creates.
+# Reference: vendor/.../common/pkg/apis/common/v1/constants.go:3-18.
+LABEL_GROUP_NAME = "group-name"
+LABEL_JOB_NAME = "job-name"
+LABEL_REPLICA_TYPE = "replica-type"
+LABEL_REPLICA_INDEX = "replica-index"
+LABEL_JOB_ROLE = "job-role"
+JOB_ROLE_MASTER = "master"
+
+# Gang-scheduling annotations (reference: tensorflow/pod.go:221-235 uses
+# Volcano's scheduling.k8s.io/group-name + volcano.sh/task-spec).
+ANNOTATION_GANG_GROUP = "scheduling.tpu-operator.dev/group-name"
+ANNOTATION_GANG_TASK = "scheduling.tpu-operator.dev/task-spec"
+
+DEFAULT_GANG_SCHEDULER = "slice-gang"

@@ -1,0 +1,122 @@
+"""Dataclass <-> camelCase-dict serialization for API objects.
+
+The reference gets this from Kubernetes codegen (zz_generated.deepcopy.go,
+openapi_generated.go). Here a single reflective base class covers the whole
+API surface: snake_case dataclass fields serialize to camelCase wire keys
+(K8s JSON convention), datetimes to RFC3339, nested ApiObjects recursively.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import datetime as _dt
+import functools
+from typing import Any, Optional, Union, get_args, get_origin, get_type_hints
+
+
+def snake_to_camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _rfc3339(ts: _dt.datetime) -> str:
+    if ts.tzinfo is None:
+        ts = ts.replace(tzinfo=_dt.timezone.utc)
+    ts = ts.astimezone(_dt.timezone.utc)
+    if ts.microsecond:
+        return ts.strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+    return ts.strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def parse_time(v: Union[str, _dt.datetime, None]) -> Optional[_dt.datetime]:
+    if v is None or isinstance(v, _dt.datetime):
+        return v
+    s = v.replace("Z", "+00:00")
+    return _dt.datetime.fromisoformat(s)
+
+
+def _unwrap_optional(tp: Any) -> Any:
+    if get_origin(tp) is Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _encode(value: Any) -> Any:
+    if value is None:
+        return None
+    if isinstance(value, ApiObject):
+        return value.to_dict()
+    if isinstance(value, _dt.datetime):
+        return _rfc3339(value)
+    if isinstance(value, dict):
+        return {k: _encode(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_encode(v) for v in value]
+    return value
+
+
+def _decode(tp: Any, value: Any) -> Any:
+    if value is None:
+        return None
+    tp = _unwrap_optional(tp)
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        (item_tp,) = get_args(tp) or (Any,)
+        return [_decode(item_tp, v) for v in value]
+    if origin is dict:
+        args = get_args(tp)
+        val_tp = args[1] if len(args) == 2 else Any
+        return {k: _decode(val_tp, v) for k, v in value.items()}
+    if isinstance(tp, type) and issubclass(tp, ApiObject):
+        return tp.from_dict(value)
+    if tp is _dt.datetime:
+        return parse_time(value)
+    return value
+
+
+@functools.lru_cache(maxsize=None)
+def _hints_for(cls) -> dict:
+    # get_type_hints re-evaluates stringified annotations on every call;
+    # from_dict sits on the reconcile hot path, so cache per class.
+    return get_type_hints(cls)
+
+
+@dataclasses.dataclass
+class ApiObject:
+    """Base for all API dataclasses; provides wire-format round-tripping."""
+
+    def to_dict(self) -> dict:
+        out = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v is None:
+                continue
+            # Omit empty containers to keep wire objects tidy (K8s omitempty).
+            if isinstance(v, (dict, list)) and not v:
+                continue
+            out[snake_to_camel(f.name)] = _encode(v)
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "ApiObject":
+        if data is None:
+            data = {}
+        hints = _hints_for(cls)
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            wire = snake_to_camel(f.name)
+            if wire in data:
+                raw = data[wire]
+            elif f.name in data:  # tolerate snake_case input
+                raw = data[f.name]
+            else:
+                continue
+            kwargs[f.name] = _decode(hints.get(f.name, Any), raw)
+        return cls(**kwargs)
+
+    def deepcopy(self):
+        """Analog of the generated DeepCopy (zz_generated.deepcopy.go)."""
+        return copy.deepcopy(self)

@@ -1,0 +1,384 @@
+"""TPUJob API types.
+
+TPU-native rebuild of the TFJob CRD object model:
+
+- reference pkg/apis/tensorflow/v1/types.go:27-108 (TFJob/TFJobSpec, replica
+  type constants PS/Worker/Chief/Master/Evaluator)
+- reference vendor/.../kubeflow/common/pkg/apis/common/v1/types.go:24-204
+  (ReplicaSpec, JobStatus, JobCondition, RunPolicy, RestartPolicy,
+  CleanPodPolicy, SchedulingPolicy)
+
+Differences are deliberate and TPU-first:
+
+- ``TPUJobSpec.slice`` declares accelerator type / slice topology / slice
+  count so the scheduler can do ICI-topology-aware gang placement (the
+  reference had no device topology concept; Volcano PodGroups were shape
+  blind).
+- Replica env bootstrap targets ``jax.distributed`` (coordinator + worker
+  ranks) instead of TF_CONFIG; see tf_operator_tpu/bootstrap/.
+- Pods model *processes* (command/env/ports), so the same engine drives a
+  subprocess backend locally and a real cluster backend in deployment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+from dataclasses import field
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.serde import ApiObject
+
+
+# ---------------------------------------------------------------------------
+# Replica types (reference: types.go:73-92)
+# ---------------------------------------------------------------------------
+
+class ReplicaType:
+    """Replica roles. Keys in TPUJobSpec.replica_specs (normalized lowercase).
+
+    The reference camel-cased these ("Worker"); we canonicalize to lowercase
+    on defaulting, mirroring setTypeNamesToCamelCase (defaults.go:70-89).
+    """
+
+    CHIEF = "chief"
+    MASTER = "master"
+    WORKER = "worker"
+    PS = "ps"
+    EVALUATOR = "evaluator"
+
+    ALL = (CHIEF, MASTER, WORKER, PS, EVALUATOR)
+
+
+def is_chief_or_master(rtype: str) -> bool:
+    """Reference: pkg/apis/tensorflow/v1/util.go:22-27."""
+    return rtype.lower() in (ReplicaType.CHIEF, ReplicaType.MASTER)
+
+
+def is_worker(rtype: str) -> bool:
+    return rtype.lower() == ReplicaType.WORKER
+
+
+def is_evaluator(rtype: str) -> bool:
+    return rtype.lower() == ReplicaType.EVALUATOR
+
+
+# ---------------------------------------------------------------------------
+# Policies (reference: common/v1/types.go:107-204, tensorflow/v1/common.go)
+# ---------------------------------------------------------------------------
+
+class RestartPolicy:
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+    # Restart decision depends on the container exit code; retryable codes
+    # restart the replica in place (same index), permanent codes fail it.
+    EXIT_CODE = "ExitCode"
+
+    ALL = (ALWAYS, ON_FAILURE, NEVER, EXIT_CODE)
+
+
+class CleanPodPolicy:
+    ALL = "All"
+    RUNNING = "Running"
+    NONE = "None"
+
+
+class SuccessPolicy:
+    """Reference: pkg/apis/tensorflow/v1/common.go:17-23."""
+
+    DEFAULT = ""          # chief (or worker-0 when chiefless) decides
+    ALL_WORKERS = "AllWorkers"
+
+
+class JobConditionType:
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class ConditionStatus:
+    TRUE = "True"
+    FALSE = "False"
+    UNKNOWN = "Unknown"
+
+
+class PodPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+# ---------------------------------------------------------------------------
+# Object metadata (subset of K8s ObjectMeta the engine actually uses)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class OwnerReference(ApiObject):
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+    block_owner_deletion: bool = True
+
+
+@dataclasses.dataclass
+class ObjectMeta(ApiObject):
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: Optional[_dt.datetime] = None
+    deletion_timestamp: Optional[_dt.datetime] = None
+    resource_version: int = 0
+    owner_references: List[OwnerReference] = field(default_factory=list)
+
+    def controller_ref(self) -> Optional[OwnerReference]:
+        for ref in self.owner_references:
+            if ref.controller:
+                return ref
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Pod model (process spec; subset of core/v1 Pod the framework needs)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Container(ApiObject):
+    """One process in a pod. ``command`` is the argv the runtime execs.
+
+    ``image`` is carried for cluster backends; the local subprocess backend
+    ignores it. The bootstrap layer injects env into the container whose
+    name is constants.DEFAULT_CONTAINER_NAME (reference: the "tensorflow"
+    container, defaults.go:36-58).
+    """
+
+    name: str = constants.DEFAULT_CONTAINER_NAME
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    ports: Dict[str, int] = field(default_factory=dict)  # name -> port
+    resources: Dict[str, str] = field(default_factory=dict)
+    working_dir: str = ""
+
+
+@dataclasses.dataclass
+class PodSpec(ApiObject):
+    containers: List[Container] = field(default_factory=list)
+    restart_policy: str = RestartPolicy.NEVER
+    scheduler_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+
+    def container(self, name: str) -> Optional[Container]:
+        for c in self.containers:
+            if c.name == name:
+                return c
+        return None
+
+
+@dataclasses.dataclass
+class ContainerStatus(ApiObject):
+    name: str = ""
+    state: str = ""                 # Waiting|Running|Terminated
+    exit_code: Optional[int] = None
+    restart_count: int = 0
+    message: str = ""
+
+
+@dataclasses.dataclass
+class PodStatus(ApiObject):
+    phase: str = PodPhase.PENDING
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
+    start_time: Optional[_dt.datetime] = None
+    host: str = ""
+    message: str = ""
+
+    def container_status(self, name: str) -> Optional[ContainerStatus]:
+        for cs in self.container_statuses:
+            if cs.name == name:
+                return cs
+        return None
+
+
+@dataclasses.dataclass
+class PodTemplateSpec(ApiObject):
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclasses.dataclass
+class Pod(ApiObject):
+    api_version: str = "v1"
+    kind: str = "Pod"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+
+@dataclasses.dataclass
+class EndpointSpec(ApiObject):
+    """Discovery record for one replica (analog of the per-replica headless
+    Service, reference common/service.go:277-339). Maps a stable DNS-ish
+    name to the selected pod's host/ports."""
+
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Endpoint(ApiObject):
+    api_version: str = "v1"
+    kind: str = "Endpoint"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: EndpointSpec = field(default_factory=EndpointSpec)
+
+
+# ---------------------------------------------------------------------------
+# Job spec
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SchedulingPolicy(ApiObject):
+    """Gang scheduling knobs (reference common/v1/types.go:193-204)."""
+
+    min_available: Optional[int] = None
+    queue: str = ""
+    min_resources: Dict[str, str] = field(default_factory=dict)
+    priority_class: str = ""
+
+
+@dataclasses.dataclass
+class RunPolicy(ApiObject):
+    """Reference common/v1/types.go:107-148."""
+
+    clean_pod_policy: Optional[str] = None
+    ttl_seconds_after_finished: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: Optional[int] = None
+    scheduling_policy: Optional[SchedulingPolicy] = None
+
+
+@dataclasses.dataclass
+class ReplicaSpec(ApiObject):
+    """Reference common/v1/types.go:24-55."""
+
+    replicas: Optional[int] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    restart_policy: str = ""
+
+
+@dataclasses.dataclass
+class TPUSliceSpec(ApiObject):
+    """TPU slice topology request — first-class in the TPU-native API.
+
+    accelerator: e.g. "v5p-32", "v5e-16", "v4-8" (chips = suffix).
+    topology:    optional explicit ICI mesh, e.g. "2x2x4"; derived from the
+                 accelerator when omitted (bootstrap/topology.py).
+    num_slices:  >1 = multislice over DCN (megascale).
+    """
+
+    accelerator: str = ""
+    topology: str = ""
+    num_slices: int = 1
+
+
+@dataclasses.dataclass
+class TPUJobSpec(ApiObject):
+    """Reference pkg/apis/tensorflow/v1/types.go:47-68."""
+
+    replica_specs: Dict[str, ReplicaSpec] = field(default_factory=dict)
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    success_policy: str = SuccessPolicy.DEFAULT
+    # Elastic membership: workers get sparse cluster views so membership can
+    # change without restarting the world (reference enableDynamicWorker,
+    # types.go:66-67).
+    enable_elastic_worker: bool = False
+    slice: TPUSliceSpec = field(default_factory=TPUSliceSpec)
+
+
+# ---------------------------------------------------------------------------
+# Job status (reference common/v1/types.go:56-106)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class JobCondition(ApiObject):
+    type: str = ""
+    status: str = ConditionStatus.TRUE
+    reason: str = ""
+    message: str = ""
+    last_update_time: Optional[_dt.datetime] = None
+    last_transition_time: Optional[_dt.datetime] = None
+
+
+@dataclasses.dataclass
+class ReplicaStatus(ApiObject):
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclasses.dataclass
+class JobStatus(ApiObject):
+    conditions: List[JobCondition] = field(default_factory=list)
+    replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[_dt.datetime] = None
+    completion_time: Optional[_dt.datetime] = None
+    last_reconcile_time: Optional[_dt.datetime] = None
+
+
+@dataclasses.dataclass
+class TPUJob(ApiObject):
+    api_version: str = constants.API_VERSION
+    kind: str = constants.KIND
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TPUJobSpec = field(default_factory=TPUJobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+# ---------------------------------------------------------------------------
+# SliceGroup: gang-scheduling unit (reference: Volcano PodGroup,
+# common/job_controller.go:218-322)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SliceGroupSpec(ApiObject):
+    min_member: int = 0
+    queue: str = ""
+    priority_class: str = ""
+    min_resources: Dict[str, str] = field(default_factory=dict)
+    # TPU extension: the slice shape this gang must land on, all-or-nothing.
+    slice: TPUSliceSpec = field(default_factory=lambda: TPUSliceSpec())
+
+
+@dataclasses.dataclass
+class SliceGroupStatus(ApiObject):
+    phase: str = "Pending"  # Pending|Inqueue|Running|Unknown
+
+
+@dataclasses.dataclass
+class SliceGroup(ApiObject):
+    api_version: str = constants.API_VERSION
+    kind: str = "SliceGroup"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: SliceGroupSpec = field(default_factory=SliceGroupSpec)
+    status: SliceGroupStatus = field(default_factory=SliceGroupStatus)
+
+
+def gen_general_name(job_name: str, rtype: str, index: int) -> str:
+    """Stable replica identity: ``{job}-{rtype}-{index}``.
+
+    Reference: vendor/.../common/pkg/controller.v1/common/util.go:47-50.
+    """
+    return f"{job_name}-{rtype.lower()}-{index}"

@@ -1,0 +1,140 @@
+"""TPUJob spec validation.
+
+Reference: pkg/apis/tensorflow/validation/validation.go:27-66 —
+spec non-nil; every replica has containers; container image/command
+non-empty; a container named after the default container exists; at most
+one Chief/Master. TPU additions: known restart/clean policies, replica
+counts, slice accelerator syntax.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    ReplicaType,
+    RestartPolicy,
+    SuccessPolicy,
+    TPUJob,
+    TPUJobSpec,
+    is_chief_or_master,
+)
+
+_ACCELERATOR_RE = re.compile(r"^(v[0-9]+[a-z]*)-([0-9]+)$")
+_TOPOLOGY_RE = re.compile(r"^[0-9]+(x[0-9]+)*$")
+# RFC 1123 subdomain, as enforced by the K8s API server on metadata.name.
+_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+
+class ValidationError(ValueError):
+    """Raised when a TPUJob spec is invalid; message lists every finding."""
+
+    def __init__(self, errors: List[str]):
+        self.errors = list(errors)
+        super().__init__("; ".join(self.errors))
+
+
+def validate_job(job: TPUJob) -> None:
+    errors = list(_job_errors(job))
+    if errors:
+        raise ValidationError(errors)
+
+
+def _job_errors(job: TPUJob):
+    if not job.metadata.name:
+        yield "metadata.name must be set"
+    elif not _NAME_RE.match(job.metadata.name):
+        yield (f"metadata.name {job.metadata.name!r} must be a lowercase "
+               "RFC-1123 subdomain")
+    yield from _spec_errors(job.spec)
+
+
+def _spec_errors(spec: TPUJobSpec):
+    if not spec.replica_specs:
+        # Reference: "TFJobSpec is not valid" on nil TFReplicaSpecs
+        # (validation.go:31-33).
+        yield "spec.replicaSpecs must declare at least one replica type"
+        return
+
+    chief_like = 0
+    for rtype, rspec in spec.replica_specs.items():
+        path = f"spec.replicaSpecs[{rtype}]"
+        if rtype.lower() not in ReplicaType.ALL:
+            yield (f"{path}: unknown replica type; expected one of "
+                   f"{', '.join(ReplicaType.ALL)}")
+        if is_chief_or_master(rtype):
+            chief_like += 1
+        if rspec.replicas is not None and not isinstance(rspec.replicas, int):
+            yield f"{path}.replicas must be an integer"
+        elif rspec.replicas is not None and rspec.replicas < 0:
+            yield f"{path}.replicas must be >= 0"
+        if rspec.restart_policy and rspec.restart_policy not in RestartPolicy.ALL:
+            yield (f"{path}.restartPolicy {rspec.restart_policy!r} invalid; "
+                   f"expected one of {', '.join(RestartPolicy.ALL)}")
+        yield from _template_errors(path, rspec)
+
+    if chief_like > 1:
+        # Reference: "more than 1 chief/master found" (validation.go:58-64).
+        yield "spec.replicaSpecs: at most one chief/master replica type allowed"
+
+    if spec.success_policy not in (SuccessPolicy.DEFAULT, SuccessPolicy.ALL_WORKERS):
+        yield (f"spec.successPolicy {spec.success_policy!r} invalid; expected "
+               f"'' or {SuccessPolicy.ALL_WORKERS!r}")
+
+    cpp = spec.run_policy.clean_pod_policy
+    if cpp is not None and cpp not in (CleanPodPolicy.ALL, CleanPodPolicy.RUNNING,
+                                       CleanPodPolicy.NONE):
+        yield f"spec.runPolicy.cleanPodPolicy {cpp!r} invalid"
+    bl = spec.run_policy.backoff_limit
+    if bl is not None and bl < 0:
+        yield "spec.runPolicy.backoffLimit must be >= 0"
+    ads = spec.run_policy.active_deadline_seconds
+    if ads is not None and ads < 0:
+        yield "spec.runPolicy.activeDeadlineSeconds must be >= 0"
+    ttl = spec.run_policy.ttl_seconds_after_finished
+    if ttl is not None and ttl < 0:
+        yield "spec.runPolicy.ttlSecondsAfterFinished must be >= 0"
+
+    yield from _slice_errors(spec)
+
+
+def _template_errors(path: str, rspec):
+    containers = rspec.template.spec.containers
+    if not containers:
+        # Reference: "Content of replica template is empty" (validation.go:40-44).
+        yield f"{path}.template.spec.containers must not be empty"
+        return
+    default_found = False
+    for i, c in enumerate(containers):
+        if not c.name:
+            yield f"{path}.template.spec.containers[{i}].name must be set"
+        if c.name == constants.DEFAULT_CONTAINER_NAME:
+            default_found = True
+            if not c.command and not c.image:
+                # Reference requires image non-empty (validation.go:46-50);
+                # local process pods require a command instead.
+                yield (f"{path}.template.spec.containers[{i}] must set "
+                       "command or image")
+    if not default_found:
+        # Reference: "There is no container named tensorflow" (validation.go:52-57).
+        yield (f"{path}.template.spec: no container named "
+               f"{constants.DEFAULT_CONTAINER_NAME!r}")
+
+
+def _slice_errors(spec: TPUJobSpec):
+    sl = spec.slice
+    if sl.accelerator:
+        m = _ACCELERATOR_RE.match(sl.accelerator)
+        if not m:
+            yield (f"spec.slice.accelerator {sl.accelerator!r} invalid; "
+                   "expected e.g. 'v5p-32'")
+        elif int(m.group(2)) < 1:
+            yield "spec.slice.accelerator chip count must be >= 1"
+    if sl.topology and not _TOPOLOGY_RE.match(sl.topology):
+        yield (f"spec.slice.topology {sl.topology!r} invalid; expected e.g. "
+               "'2x2x4'")
+    if sl.num_slices < 1:
+        yield "spec.slice.numSlices must be >= 1"

@@ -1,0 +1,8 @@
+"""Version info (reference: pkg/version/version.go:21-43)."""
+
+__version__ = "0.1.0"
+GIT_SHA = "dev"
+
+
+def version_string() -> str:
+    return f"tpu-operator v{__version__} (git {GIT_SHA})"
