@@ -1,0 +1,71 @@
+"""Condition machine tests (reference behavior of util/status.go)."""
+
+import datetime as dt
+
+from tf_operator_tpu.api.types import ConditionStatus, JobConditionType, JobStatus
+from tf_operator_tpu.controller import conditions as C
+
+
+def types_of(status):
+    return [(c.type, c.status) for c in status.conditions]
+
+
+def test_created_then_running():
+    st = JobStatus()
+    C.update_job_conditions(st, JobConditionType.CREATED, C.JOB_CREATED_REASON, "m")
+    C.update_job_conditions(st, JobConditionType.RUNNING, C.JOB_RUNNING_REASON, "m")
+    assert types_of(st) == [("Created", "True"), ("Running", "True")]
+    assert C.is_running(st)
+    assert not C.is_finished(st)
+
+
+def test_idempotent_set_preserves_transition_time():
+    st = JobStatus()
+    C.update_job_conditions(st, JobConditionType.RUNNING, C.JOB_RUNNING_REASON, "a")
+    t0 = C.get_condition(st, JobConditionType.RUNNING).last_transition_time
+    C.update_job_conditions(st, JobConditionType.RUNNING, C.JOB_RUNNING_REASON, "b")
+    # identical (type,status,reason): no-op, message unchanged
+    cond = C.get_condition(st, JobConditionType.RUNNING)
+    assert cond.message == "a"
+    assert cond.last_transition_time == t0
+    assert len(st.conditions) == 1
+
+
+def test_reason_change_replaces_but_keeps_transition_time():
+    st = JobStatus()
+    C.update_job_conditions(st, JobConditionType.RUNNING, "ReasonA", "a")
+    t0 = C.get_condition(st, JobConditionType.RUNNING).last_transition_time
+    C.update_job_conditions(st, JobConditionType.RUNNING, "ReasonB", "b")
+    cond = C.get_condition(st, JobConditionType.RUNNING)
+    assert cond.reason == "ReasonB"
+    # status unchanged -> lastTransitionTime preserved (status.go:89-92)
+    assert cond.last_transition_time == t0
+
+
+def test_running_restarting_mutually_exclusive():
+    st = JobStatus()
+    C.update_job_conditions(st, JobConditionType.RUNNING, C.JOB_RUNNING_REASON, "")
+    C.update_job_conditions(st, JobConditionType.RESTARTING, C.JOB_RESTARTING_REASON, "")
+    assert types_of(st) == [("Restarting", "True")]
+    C.update_job_conditions(st, JobConditionType.RUNNING, C.JOB_RUNNING_REASON, "")
+    assert types_of(st) == [("Running", "True")]
+
+
+def test_succeeded_demotes_running_to_false():
+    st = JobStatus()
+    C.update_job_conditions(st, JobConditionType.CREATED, C.JOB_CREATED_REASON, "")
+    C.update_job_conditions(st, JobConditionType.RUNNING, C.JOB_RUNNING_REASON, "")
+    C.update_job_conditions(st, JobConditionType.SUCCEEDED, C.JOB_SUCCEEDED_REASON, "")
+    assert ("Running", "False") in types_of(st)
+    assert C.is_succeeded(st)
+    assert not C.is_running(st)
+    assert C.is_finished(st)
+
+
+def test_failed_freezes_status():
+    st = JobStatus()
+    C.update_job_conditions(st, JobConditionType.FAILED, C.JOB_FAILED_REASON, "boom")
+    C.update_job_conditions(st, JobConditionType.RUNNING, C.JOB_RUNNING_REASON, "")
+    C.update_job_conditions(st, JobConditionType.SUCCEEDED, C.JOB_SUCCEEDED_REASON, "")
+    assert types_of(st) == [("Failed", "True")]
+    assert C.is_failed(st)

@@ -1,0 +1,384 @@
+"""Reconcile engine tests.
+
+Mirrors the reference's controller_test.go TestNormalPath matrix,
+pod_test.go (scale up/down, exit codes, expectations) and job_test.go
+(clean-pod policies, TTL, backoff, deadline).
+"""
+
+import datetime as dt
+
+import pytest
+
+from tf_operator_tpu import testutil
+from tf_operator_tpu.api import constants, set_defaults
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    JobConditionType,
+    PodPhase,
+    RestartPolicy,
+)
+from tf_operator_tpu.controller import conditions as cond
+from tf_operator_tpu.controller.control import FakeEndpointControl, FakePodControl
+from tf_operator_tpu.controller.engine import EngineConfig, JobEngine
+from tf_operator_tpu.controller.expectations import expectation_key
+
+
+def make_engine(plugin, **kw):
+    return JobEngine(plugin=plugin, pod_control=FakePodControl(),
+                     endpoint_control=FakeEndpointControl(), **kw)
+
+
+def run_sync(job, pods=(), endpoints=(), **kw):
+    plugin = testutil.StubPlugin(pods=pods, endpoints=endpoints)
+    engine = make_engine(plugin, **kw)
+    plugin.workqueue = engine.workqueue
+    set_defaults(job)
+    engine.reconcile_jobs(job)
+    return engine, plugin
+
+
+# ---------------------------------------------------------------------------
+# TestNormalPath analog: table of (topology, pod phases) -> expectations
+# ---------------------------------------------------------------------------
+
+NORMAL_PATH_CASES = [
+    # name, worker, ps, pod phases {rtype: (pending, active, succeeded, failed)},
+    # expected creations, deletions, then expected
+    # (active, succeeded, failed) tallies per rtype.
+    ("all-new", 4, 2, {}, 6, 0, {"worker": (0, 0, 0), "ps": (0, 0, 0)}),
+    ("all-pending", 4, 2, {"worker": (4, 0, 0, 0), "ps": (2, 0, 0, 0)},
+     0, 0, {"worker": (0, 0, 0), "ps": (0, 0, 0)}),
+    ("all-running", 4, 2, {"worker": (0, 4, 0, 0), "ps": (0, 2, 0, 0)},
+     0, 0, {"worker": (4, 0, 0), "ps": (2, 0, 0)}),
+    ("partial", 4, 2, {"worker": (2, 0, 0, 0), "ps": (1, 0, 0, 0)},
+     3, 0, {"worker": (0, 0, 0), "ps": (0, 0, 0)}),
+    ("worker-succeeded", 4, 2, {"worker": (0, 0, 4, 0), "ps": (0, 2, 0, 0)},
+     0, 0, {"worker": (0, 4, 0), "ps": (2, 0, 0)}),
+    ("one-failed", 4, 2, {"worker": (0, 3, 0, 1), "ps": (0, 2, 0, 0)},
+     0, 0, {"worker": (3, 0, 1), "ps": (2, 0, 0)}),
+]
+
+
+@pytest.mark.parametrize(
+    "name,worker,ps,phases,want_creates,want_deletes,want_statuses",
+    NORMAL_PATH_CASES, ids=[c[0] for c in NORMAL_PATH_CASES])
+def test_normal_path(name, worker, ps, phases, want_creates, want_deletes,
+                     want_statuses):
+    job = testutil.new_tpujob(worker=worker, ps=ps)
+    pods = []
+    for rtype, (pending, active, succeeded, failed) in phases.items():
+        testutil.set_pod_statuses(pods, job, rtype, pending=pending,
+                                  active=active, succeeded=succeeded,
+                                  failed=failed)
+    engine, plugin = run_sync(job, pods=pods)
+    assert len(engine.pod_control.templates) == want_creates
+    assert len(engine.pod_control.delete_pod_names) == want_deletes
+    for rtype, (active, succeeded, failed) in want_statuses.items():
+        rs = job.status.replica_statuses[rtype]
+        assert (rs.active, rs.succeeded, rs.failed) == (active, succeeded, failed), rtype
+
+
+def test_created_pods_have_identity_labels_and_env():
+    job = testutil.new_tpujob(worker=2, ps=1)
+    engine, plugin = run_sync(job)
+    created = engine.pod_control.templates
+    assert len(created) == 3
+    names = sorted(p.metadata.name for p in created)
+    assert names == ["test-tpujob-ps-0", "test-tpujob-worker-0",
+                     "test-tpujob-worker-1"]
+    for p in created:
+        assert p.metadata.labels[constants.LABEL_GROUP_NAME] == constants.GROUP
+        assert p.metadata.labels[constants.LABEL_JOB_NAME] == job.metadata.name
+        assert p.metadata.owner_references[0].uid == job.metadata.uid
+    # worker-0 is master-role when no chief exists (controller.go:418-425)
+    w0 = next(p for p in created if p.metadata.name.endswith("worker-0"))
+    assert w0.metadata.labels[constants.LABEL_JOB_ROLE] == "master"
+    w1 = next(p for p in created if p.metadata.name.endswith("worker-1"))
+    assert constants.LABEL_JOB_ROLE not in w1.metadata.labels
+    # cluster spec env injected
+    assert w1.spec.containers[0].env["TPU_WORKER_ID"] == "1"
+
+
+def test_endpoints_created_per_replica():
+    job = testutil.new_tpujob(worker=2)
+    engine, plugin = run_sync(job)
+    eps = engine.endpoint_control.templates
+    assert sorted(e.metadata.name for e in eps) == [
+        "test-tpujob-worker-0", "test-tpujob-worker-1"]
+    for e in eps:
+        assert e.spec.ports[constants.DEFAULT_PORT_NAME] == constants.DEFAULT_PORT
+        assert e.spec.selector[constants.LABEL_REPLICA_INDEX] in ("0", "1")
+
+
+def test_scale_down_deletes_out_of_range():
+    # Reference pod_test.go TestScaleDown: pods 0,1,2 with replicas=2 ->
+    # exactly worker-2 deleted.
+    job = testutil.new_tpujob(worker=2)
+    pods = testutil.new_pod_list(job, "worker", 3, phase=PodPhase.RUNNING)
+    engine, plugin = run_sync(job, pods=pods)
+    assert engine.pod_control.delete_pod_names == ["test-tpujob-worker-2"]
+    assert engine.pod_control.templates == []
+
+
+def test_scale_up_creates_missing_indices():
+    job = testutil.new_tpujob(worker=4)
+    pods = testutil.new_pod_list(job, "worker", 2, phase=PodPhase.RUNNING)
+    engine, plugin = run_sync(job, pods=pods)
+    assert sorted(p.metadata.name for p in engine.pod_control.templates) == [
+        "test-tpujob-worker-2", "test-tpujob-worker-3"]
+
+
+def test_gap_in_indices_is_refilled():
+    job = testutil.new_tpujob(worker=3)
+    pods = [testutil.new_pod(job, "worker", 0, phase=PodPhase.RUNNING),
+            testutil.new_pod(job, "worker", 2, phase=PodPhase.RUNNING)]
+    engine, plugin = run_sync(job, pods=pods)
+    assert [p.metadata.name for p in engine.pod_control.templates] == [
+        "test-tpujob-worker-1"]
+
+
+def test_exit_code_retryable_restarts_pod():
+    # Reference pod_test.go TestExitCode: failed worker exit 130 -> deleted
+    # for restart + Restarting condition.
+    job = testutil.new_tpujob(worker=1)
+    job.spec.replica_specs["worker"].restart_policy = RestartPolicy.EXIT_CODE
+    pods = [testutil.new_pod(job, "worker", 0, phase=PodPhase.FAILED,
+                             exit_code=130)]
+    engine, plugin = run_sync(job, pods=pods)
+    assert engine.pod_control.delete_pod_names == ["test-tpujob-worker-0"]
+    assert testutil.check_condition(job, JobConditionType.RESTARTING)
+    # restarting in flight: no Failed condition
+    assert not cond.is_failed(job.status)
+
+
+def test_exit_code_restart_with_running_sibling_does_not_fail_job():
+    # Regression: a retryable failure on worker-1 while worker-0 is Running
+    # must not mark the job Failed (the Running condition clears Restarting
+    # via mutual exclusion; the failed>0 guard must use the pre-roll-up
+    # restart state).
+    job = testutil.new_tpujob(worker=2)
+    job.spec.replica_specs["worker"].restart_policy = RestartPolicy.EXIT_CODE
+    pods = [testutil.new_pod(job, "worker", 0, phase=PodPhase.RUNNING),
+            testutil.new_pod(job, "worker", 1, phase=PodPhase.FAILED,
+                             exit_code=137)]
+    engine, plugin = run_sync(job, pods=pods)
+    assert engine.pod_control.delete_pod_names == ["test-tpujob-worker-1"]
+    assert not cond.is_failed(job.status)
+    assert cond.is_running(job.status)
+
+
+def test_exit_code_permanent_fails_job():
+    job = testutil.new_tpujob(worker=1)
+    job.spec.replica_specs["worker"].restart_policy = RestartPolicy.EXIT_CODE
+    pods = [testutil.new_pod(job, "worker", 0, phase=PodPhase.FAILED,
+                             exit_code=1)]
+    engine, plugin = run_sync(job, pods=pods)
+    assert engine.pod_control.delete_pod_names == []
+    assert cond.is_failed(job.status)
+
+
+def test_exit_code_restart_policy_maps_to_never_on_pod():
+    # Reference setRestartPolicy (pod.go:319-326).
+    job = testutil.new_tpujob(worker=1)
+    job.spec.replica_specs["worker"].restart_policy = RestartPolicy.EXIT_CODE
+    engine, plugin = run_sync(job)
+    assert engine.pod_control.templates[0].spec.restart_policy == RestartPolicy.NEVER
+
+
+def test_expectations_block_second_create(  ):
+    job = testutil.new_tpujob(worker=1)
+    engine, plugin = run_sync(job)
+    key = expectation_key(job.key(), "pods", "worker")
+    assert not engine.expectations.satisfied_expectations(key)
+    engine.expectations.creation_observed(key)
+    assert engine.expectations.satisfied_expectations(key)
+
+
+def test_create_error_rolls_back_expectation():
+    # Reference pod_test.go TestExpectationWithError.
+    job = testutil.new_tpujob(worker=1)
+    set_defaults(job)
+    plugin = testutil.StubPlugin()
+    engine = make_engine(plugin)
+    engine.pod_control.create_error = RuntimeError("boom")
+    with pytest.raises(RuntimeError):
+        engine.reconcile_jobs(job)
+    key = expectation_key(job.key(), "pods", "worker")
+    assert engine.expectations.satisfied_expectations(key)
+
+
+# ---------------------------------------------------------------------------
+# Success/failure semantics (status_test.go TestStatus analog)
+# ---------------------------------------------------------------------------
+
+def run_status(job, pods):
+    return run_sync(job, pods=pods)
+
+
+def test_chief_running_sets_running():
+    job = testutil.new_tpujob(worker=2, chief=1)
+    pods = testutil.new_pod_list(job, "worker", 2, phase=PodPhase.RUNNING)
+    pods += testutil.new_pod_list(job, "chief", 1, phase=PodPhase.RUNNING)
+    engine, plugin = run_status(job, pods)
+    assert cond.is_running(job.status)
+    assert not cond.is_finished(job.status)
+
+
+def test_chief_succeeded_sets_succeeded():
+    job = testutil.new_tpujob(worker=2, chief=1)
+    pods = testutil.new_pod_list(job, "worker", 2, phase=PodPhase.RUNNING)
+    pods += testutil.new_pod_list(job, "chief", 1, phase=PodPhase.SUCCEEDED)
+    engine, plugin = run_status(job, pods)
+    assert cond.is_succeeded(job.status)
+    assert job.status.completion_time is not None
+
+
+def test_chief_failed_sets_failed():
+    job = testutil.new_tpujob(worker=2, chief=1)
+    pods = testutil.new_pod_list(job, "worker", 2, phase=PodPhase.RUNNING)
+    pods += testutil.new_pod_list(job, "chief", 1, phase=PodPhase.FAILED)
+    engine, plugin = run_status(job, pods)
+    assert cond.is_failed(job.status)
+
+
+def test_worker0_completion_decides_when_chiefless():
+    # Reference "(No chief worker) Worker 0 completed" scenario.
+    job = testutil.new_tpujob(worker=2)
+    pods = [testutil.new_pod(job, "worker", 0, phase=PodPhase.SUCCEEDED),
+            testutil.new_pod(job, "worker", 1, phase=PodPhase.RUNNING)]
+    engine, plugin = run_status(job, pods)
+    assert cond.is_succeeded(job.status)
+
+
+def test_all_workers_policy_waits_for_all():
+    job = testutil.new_tpujob(worker=2)
+    job.spec.success_policy = "AllWorkers"
+    pods = [testutil.new_pod(job, "worker", 0, phase=PodPhase.SUCCEEDED),
+            testutil.new_pod(job, "worker", 1, phase=PodPhase.RUNNING)]
+    engine, plugin = run_status(job, pods)
+    assert not cond.is_succeeded(job.status)
+    assert cond.is_running(job.status)
+
+    pods[1] = testutil.new_pod(job, "worker", 1, phase=PodPhase.SUCCEEDED)
+    engine, plugin = run_status(job, pods)
+    assert cond.is_succeeded(job.status)
+
+
+def test_worker_failed_chiefless_sets_failed():
+    job = testutil.new_tpujob(worker=2)
+    pods = [testutil.new_pod(job, "worker", 0, phase=PodPhase.RUNNING),
+            testutil.new_pod(job, "worker", 1, phase=PodPhase.FAILED)]
+    engine, plugin = run_status(job, pods)
+    assert cond.is_failed(job.status)
+
+
+def test_start_time_set_once():
+    job = testutil.new_tpujob(worker=1)
+    engine, plugin = run_sync(job)
+    t0 = job.status.start_time
+    assert t0 is not None
+    engine.reconcile_jobs(job)
+    assert job.status.start_time == t0
+
+
+# ---------------------------------------------------------------------------
+# RunPolicy: cleanup, TTL, backoff, deadline (job_test.go analog)
+# ---------------------------------------------------------------------------
+
+def finished_job(worker=2, policy=CleanPodPolicy.RUNNING):
+    job = testutil.new_tpujob(worker=worker)
+    set_defaults(job)
+    job.spec.run_policy.clean_pod_policy = policy
+    cond.update_job_conditions(job.status, JobConditionType.SUCCEEDED,
+                               cond.JOB_SUCCEEDED_REASON, "done")
+    job.status.completion_time = testutil.now()
+    return job
+
+
+def test_clean_pod_policy_running_keeps_finished_pods():
+    job = finished_job(policy=CleanPodPolicy.RUNNING)
+    pods = [testutil.new_pod(job, "worker", 0, phase=PodPhase.RUNNING),
+            testutil.new_pod(job, "worker", 1, phase=PodPhase.SUCCEEDED)]
+    engine, plugin = run_sync(job, pods=pods)
+    assert engine.pod_control.delete_pod_names == ["test-tpujob-worker-0"]
+
+
+def test_clean_pod_policy_all_deletes_everything():
+    job = finished_job(policy=CleanPodPolicy.ALL)
+    pods = [testutil.new_pod(job, "worker", 0, phase=PodPhase.RUNNING),
+            testutil.new_pod(job, "worker", 1, phase=PodPhase.SUCCEEDED)]
+    engine, plugin = run_sync(job, pods=pods)
+    assert sorted(engine.pod_control.delete_pod_names) == [
+        "test-tpujob-worker-0", "test-tpujob-worker-1"]
+
+
+def test_clean_pod_policy_none_deletes_nothing():
+    job = finished_job(policy=CleanPodPolicy.NONE)
+    pods = [testutil.new_pod(job, "worker", 0, phase=PodPhase.RUNNING)]
+    engine, plugin = run_sync(job, pods=pods)
+    assert engine.pod_control.delete_pod_names == []
+
+
+def test_finished_job_rolls_active_into_succeeded():
+    job = finished_job()
+    from tf_operator_tpu.api.types import ReplicaStatus
+
+    job.status.replica_statuses["worker"] = ReplicaStatus(active=2, succeeded=0)
+    engine, plugin = run_sync(job, pods=[])
+    rs = job.status.replica_statuses["worker"]
+    assert (rs.active, rs.succeeded) == (0, 2)
+
+
+def test_ttl_zero_deletes_job_immediately():
+    # Reference job_test.go TestCleanupTFJob.
+    job = finished_job()
+    job.spec.run_policy.ttl_seconds_after_finished = 0
+    engine, plugin = run_sync(job, pods=[])
+    assert plugin.deleted_jobs == [job.metadata.name]
+
+
+def test_ttl_future_requeues_instead_of_deleting():
+    job = finished_job()
+    job.spec.run_policy.ttl_seconds_after_finished = 3600
+    engine, plugin = run_sync(job, pods=[])
+    assert plugin.deleted_jobs == []
+
+
+def test_active_deadline_exceeded_fails_job():
+    # Reference job_test.go TestActiveDeadlineSeconds.
+    job = testutil.new_tpujob(worker=2)
+    job.spec.run_policy.active_deadline_seconds = 1
+    job.status.start_time = testutil.now() - dt.timedelta(seconds=5)
+    pods = testutil.new_pod_list(job, "worker", 2, phase=PodPhase.RUNNING)
+    engine, plugin = run_sync(job, pods=pods)
+    assert cond.is_failed(job.status)
+    assert sorted(engine.pod_control.delete_pod_names) == [
+        "test-tpujob-worker-0", "test-tpujob-worker-1"]
+
+
+def test_backoff_limit_restart_counts():
+    # Reference TestBackoffForOnFailure: running pods whose container
+    # restart counts sum >= backoffLimit -> job fails.
+    job = testutil.new_tpujob(worker=2)
+    job.spec.replica_specs["worker"].restart_policy = RestartPolicy.ON_FAILURE
+    job.spec.run_policy.backoff_limit = 3
+    pods = testutil.new_pod_list(job, "worker", 2, phase=PodPhase.RUNNING)
+    for p in pods:
+        from tf_operator_tpu.api.types import ContainerStatus
+
+        p.status.container_statuses = [ContainerStatus(
+            name=constants.DEFAULT_CONTAINER_NAME, state="Running",
+            restart_count=2)]
+    engine, plugin = run_sync(job, pods=pods)
+    assert cond.is_failed(job.status)
+    failed = testutil.get_condition(job, JobConditionType.FAILED)
+    assert "backoff limit" in failed.message
+
+
+def test_status_written_only_on_change():
+    job = testutil.new_tpujob(worker=1)
+    pods = testutil.new_pod_list(job, "worker", 1, phase=PodPhase.RUNNING)
+    engine, plugin = run_sync(job, pods=pods)
+    assert len(plugin.status_writes) == 1
+    engine.reconcile_jobs(job)  # no change
+    assert len(plugin.status_writes) == 1

@@ -1,0 +1,20 @@
+"""Exit-code policy tests (reference train_util.go:18-53)."""
+
+import pytest
+
+from tf_operator_tpu.controller.exit_codes import is_retryable_exit_code
+
+
+@pytest.mark.parametrize("code", [1, 2, 126, 127, 128, 139])
+def test_permanent(code):
+    assert not is_retryable_exit_code(code)
+
+
+@pytest.mark.parametrize("code", [130, 137, 143, 138])
+def test_retryable(code):
+    assert is_retryable_exit_code(code)
+
+
+@pytest.mark.parametrize("code", [0, 3, 42, 100, 255])
+def test_unknown_treated_permanent(code):
+    assert not is_retryable_exit_code(code)

@@ -1,0 +1,80 @@
+"""Expectations cache tests (reference: expectation.go + pod_test.go
+TestExpectation/TestExpectationWithError)."""
+
+from tf_operator_tpu.controller.expectations import (
+    ControllerExpectations,
+    expectation_key,
+)
+
+
+def test_no_record_is_satisfied():
+    e = ControllerExpectations()
+    assert e.satisfied_expectations("ns/job/worker/pods")
+
+
+def test_creations_block_until_observed():
+    e = ControllerExpectations()
+    key = expectation_key("ns/job", "pods", "worker")
+    e.expect_creations(key, 2)
+    assert not e.satisfied_expectations(key)
+    e.creation_observed(key)
+    assert not e.satisfied_expectations(key)
+    e.creation_observed(key)
+    assert e.satisfied_expectations(key)
+
+
+def test_deletions_block_until_observed():
+    e = ControllerExpectations()
+    key = expectation_key("ns/job", "pods", "worker")
+    e.expect_deletions(key, 1)
+    assert not e.satisfied_expectations(key)
+    e.deletion_observed(key)
+    assert e.satisfied_expectations(key)
+
+
+def test_overshoot_is_satisfied():
+    e = ControllerExpectations()
+    key = "k"
+    e.expect_creations(key, 1)
+    e.creation_observed(key)
+    e.creation_observed(key)  # stray event
+    assert e.satisfied_expectations(key)
+
+
+def test_raise_after_failed_create():
+    # Reference pod.go:243-249: a failed create decrements the expectation
+    # (CreationObserved) so the controller retries; raise_expectations is the
+    # inverse used by the engine before issuing creates one-by-one.
+    e = ControllerExpectations()
+    key = "k"
+    e.expect_creations(key, 1)
+    e.creation_observed(key)  # rollback after create error
+    assert e.satisfied_expectations(key)
+    e.raise_expectations(key, 1, 0)
+    assert not e.satisfied_expectations(key)
+
+
+def test_expiry_unblocks():
+    e = ControllerExpectations(timeout=0.0)
+    key = "k"
+    e.expect_creations(key, 5)
+    import time
+
+    time.sleep(0.01)
+    assert e.satisfied_expectations(key)
+
+
+def test_delete_for_job_clears_prefix():
+    e = ControllerExpectations()
+    e.expect_creations("ns/j/worker/pods", 1)
+    e.expect_creations("ns/j/ps/endpoints", 1)
+    e.expect_creations("ns/j2/worker/pods", 1)
+    e.delete_for_job("ns/j")
+    assert e.satisfied_expectations("ns/j/worker/pods")
+    assert e.satisfied_expectations("ns/j/ps/endpoints")
+    assert not e.satisfied_expectations("ns/j2/worker/pods")
+
+
+def test_expectation_key_layout():
+    assert expectation_key("ns/j", "pods", "Worker") == "ns/j/worker/pods"
+    assert expectation_key("ns/j", "pods") == "ns/j/pods"

@@ -56,8 +56,6 @@ def set_defaults(job: TPUJob) -> TPUJob:
 
     if job.spec.run_policy.clean_pod_policy is None:
         job.spec.run_policy.clean_pod_policy = CleanPodPolicy.RUNNING
-    if job.spec.slice.num_slices < 1:
-        job.spec.slice.num_slices = 1
 
     for spec in job.spec.replica_specs.values():
         if spec.replicas is None:

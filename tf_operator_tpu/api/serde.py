@@ -84,20 +84,26 @@ def _hints_for(cls) -> dict:
     return get_type_hints(cls)
 
 
+@functools.lru_cache(maxsize=None)
+def _wire_keys_for(cls) -> tuple:
+    return tuple((f.name, snake_to_camel(f.name))
+                 for f in dataclasses.fields(cls))
+
+
 @dataclasses.dataclass
 class ApiObject:
     """Base for all API dataclasses; provides wire-format round-tripping."""
 
     def to_dict(self) -> dict:
         out = {}
-        for f in dataclasses.fields(self):
-            v = getattr(self, f.name)
+        for name, wire in _wire_keys_for(type(self)):
+            v = getattr(self, name)
             if v is None:
                 continue
             # Omit empty containers to keep wire objects tidy (K8s omitempty).
             if isinstance(v, (dict, list)) and not v:
                 continue
-            out[snake_to_camel(f.name)] = _encode(v)
+            out[wire] = _encode(v)
         return out
 
     @classmethod
@@ -106,15 +112,14 @@ class ApiObject:
             data = {}
         hints = _hints_for(cls)
         kwargs = {}
-        for f in dataclasses.fields(cls):
-            wire = snake_to_camel(f.name)
+        for name, wire in _wire_keys_for(cls):
             if wire in data:
                 raw = data[wire]
-            elif f.name in data:  # tolerate snake_case input
-                raw = data[f.name]
+            elif name in data:  # tolerate snake_case input
+                raw = data[name]
             else:
                 continue
-            kwargs[f.name] = _decode(hints.get(f.name, Any), raw)
+            kwargs[name] = _decode(hints.get(name, Any), raw)
         return cls(**kwargs)
 
     def deepcopy(self):

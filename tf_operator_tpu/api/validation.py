@@ -25,7 +25,8 @@ from tf_operator_tpu.api.types import (
 
 _ACCELERATOR_RE = re.compile(r"^(v[0-9]+[a-z]*)-([0-9]+)$")
 _TOPOLOGY_RE = re.compile(r"^[0-9]+(x[0-9]+)*$")
-# RFC 1123 subdomain, as enforced by the K8s API server on metadata.name.
+# RFC 1123 label: job names become pod names and label values, so the
+# stricter label charset applies (no dots).
 _NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
 
 
@@ -48,7 +49,7 @@ def _job_errors(job: TPUJob):
         yield "metadata.name must be set"
     elif not _NAME_RE.match(job.metadata.name):
         yield (f"metadata.name {job.metadata.name!r} must be a lowercase "
-               "RFC-1123 subdomain")
+               "RFC-1123 label (alphanumerics and '-')")
     yield from _spec_errors(job.spec)
 
 

@@ -1,0 +1,66 @@
+"""Event recorder (reference: client-go record.EventRecorder wired at
+job_controller.go:158-162; events are emitted on every lifecycle edge)."""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+log = logging.getLogger("tpu_operator.events")
+
+EVENT_TYPE_NORMAL = "Normal"
+EVENT_TYPE_WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    object_kind: str
+    object_name: str
+    namespace: str
+    type: str
+    reason: str
+    message: str
+    timestamp: _dt.datetime = field(
+        default_factory=lambda: _dt.datetime.now(_dt.timezone.utc))
+
+
+class Recorder:
+    """In-memory event sink with optional fan-out callback."""
+
+    def __init__(self, sink: Optional[Callable[[Event], None]] = None,
+                 max_events: int = 4096):
+        self._lock = threading.Lock()
+        self._events: List[Event] = []
+        self._sink = sink
+        self._max = max_events
+
+    def event(self, obj, etype: str, reason: str, message: str) -> None:
+        meta = getattr(obj, "metadata", None)
+        ev = Event(
+            object_kind=getattr(obj, "kind", type(obj).__name__),
+            object_name=getattr(meta, "name", "") if meta else "",
+            namespace=getattr(meta, "namespace", "") if meta else "",
+            type=etype, reason=reason, message=message,
+        )
+        log.debug("%s %s %s/%s: %s", etype, reason, ev.namespace,
+                  ev.object_name, message)
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > self._max:
+                self._events = self._events[-self._max:]
+        if self._sink:
+            self._sink(ev)
+
+    def events_for(self, name: str = "", reason: str = "") -> List[Event]:
+        with self._lock:
+            return [e for e in self._events
+                    if (not name or e.object_name == name)
+                    and (not reason or e.reason == reason)]
+
+    @property
+    def events(self) -> List[Event]:
+        with self._lock:
+            return list(self._events)

@@ -1,0 +1,141 @@
+"""Rate-limited work queue with K8s workqueue semantics.
+
+Reference dependency: k8s.io/client-go/util/workqueue as used by
+job_controller.go:139-142. Semantics preserved:
+
+- De-duplication: an item present in the queue is not added twice.
+- In-flight marking: an item re-added while being processed is deferred
+  until ``done`` and then requeued (level-triggered, same-key serialized —
+  this is the engine's only concurrency-safety requirement).
+- ``add_rate_limited`` applies per-item exponential backoff;
+  ``num_requeues`` feeds the engine's BackoffLimit policy;
+  ``forget`` resets the counter.
+- ``add_after`` schedules a delayed add (used for ActiveDeadlineSeconds
+  re-sync, reference status.go:84-92).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from collections import deque
+from typing import Dict, Hashable, List, Optional, Tuple
+
+
+class ShutDown(Exception):
+    pass
+
+
+class RateLimitingQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 30.0):
+        self._lock = threading.Condition()
+        self._queue: deque = deque()
+        self._dirty: set = set()
+        self._processing: set = set()
+        self._failures: Dict[Hashable, int] = {}
+        self._delayed: List[Tuple[float, int, Hashable]] = []  # heap
+        self._seq = 0
+        self._shutting_down = False
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+        self._delay_thread = threading.Thread(target=self._delay_loop,
+                                              daemon=True)
+        self._delay_thread.start()
+
+    # -- core queue -------------------------------------------------------
+
+    def add(self, item: Hashable) -> None:
+        with self._lock:
+            if self._shutting_down or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return  # re-queued by done()
+            self._queue.append(item)
+            self._lock.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Hashable:
+        """Block until an item is available. Raises ShutDown when drained
+        after shutdown, or TimeoutError on timeout."""
+        with self._lock:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._queue:
+                if self._shutting_down:
+                    raise ShutDown()
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError()
+                self._lock.wait(remaining)
+            item = self._queue.popleft()
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item
+
+    def done(self, item: Hashable) -> None:
+        with self._lock:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._lock.notify()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutting_down = True
+            self._lock.notify_all()
+
+    @property
+    def shutting_down(self) -> bool:
+        with self._lock:
+            return self._shutting_down
+
+    # -- rate limiting ----------------------------------------------------
+
+    def num_requeues(self, item: Hashable) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+    def forget(self, item: Hashable) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        delay = min(self._base_delay * (2 ** n), self._max_delay)
+        self.add_after(item, delay)
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._lock:
+            if self._shutting_down:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay,
+                                           self._seq, item))
+            self._lock.notify_all()
+
+    def _delay_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._shutting_down and not self._delayed:
+                    return
+                now = time.monotonic()
+                while self._delayed and self._delayed[0][0] <= now:
+                    _, _, item = heapq.heappop(self._delayed)
+                    if item not in self._dirty:
+                        self._dirty.add(item)
+                        if item not in self._processing:
+                            self._queue.append(item)
+                            self._lock.notify()
+                wait = 0.2
+                if self._delayed:
+                    wait = min(wait, max(0.0, self._delayed[0][0] - now))
+                self._lock.wait(wait)

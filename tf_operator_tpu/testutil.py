@@ -13,6 +13,7 @@ import uuid
 from typing import Dict, List, Optional
 
 from tf_operator_tpu.api import constants
+from tf_operator_tpu.controller.engine import JobPlugin
 from tf_operator_tpu.api.types import (
     Container,
     Endpoint,
@@ -170,6 +171,47 @@ def new_endpoint(job: TPUJob, rtype: str, index: int) -> Endpoint:
         spec=EndpointSpec(selector=replica_labels(job, rtype, index),
                           ports={constants.DEFAULT_PORT_NAME: constants.DEFAULT_PORT}),
     )
+
+
+class StubPlugin(JobPlugin):
+    """In-memory JobPlugin for engine tests: observed state is whatever the
+    test stuffs into .pods/.endpoints; API writes are recorded. This is the
+    reference's fake-clientset + AlwaysReady informer seam
+    (testutil/util.go:46-95) collapsed into one object."""
+
+    def __init__(self, pods=None, endpoints=None):
+        self.pods = list(pods or [])
+        self.endpoints = list(endpoints or [])
+        self.status_writes = []
+        self.deleted_jobs = []
+        self.cluster_spec_calls = []
+        self.workqueue = None  # optionally set by tests
+
+    def get_pods_for_job(self, job):
+        return list(self.pods)
+
+    def get_endpoints_for_job(self, job):
+        return list(self.endpoints)
+
+    def delete_job(self, job):
+        self.deleted_jobs.append(job.metadata.name)
+
+    def update_job_status(self, job, replica_specs):
+        from tf_operator_tpu.controller import status as status_mod
+
+        w0 = status_mod.is_worker0_completed(
+            job, replica_specs, self.pods, self.get_default_container_name())
+        status_mod.update_job_status(job, replica_specs, w0,
+                                     workqueue=self.workqueue)
+
+    def update_job_status_in_api(self, job):
+        self.status_writes.append(job.status.deepcopy())
+
+    def set_cluster_spec(self, job, pod, rtype, index):
+        self.cluster_spec_calls.append((rtype, index))
+        container = pod.spec.container(self.get_default_container_name())
+        if container is not None:
+            container.env["TPU_WORKER_ID"] = str(index)
 
 
 def get_condition(job: TPUJob, cond_type: str):
