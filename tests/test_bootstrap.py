@@ -1,0 +1,207 @@
+"""Topology + cluster-spec golden tests (reference pod_test.go
+TestClusterSpec and tensorflow_test.go sparse-spec tests)."""
+
+import json
+
+import pytest
+
+from tf_operator_tpu import testutil
+from tf_operator_tpu.api import set_defaults
+from tf_operator_tpu.bootstrap import (
+    build_cluster_spec,
+    parse_accelerator,
+    render_worker_env,
+)
+from tf_operator_tpu.bootstrap.cluster import (
+    coordinator_address,
+    is_distributed,
+    process_ranks,
+)
+
+
+# ---------------------------------------------------------------------------
+# Topology
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("accel,chips,topo,hosts,devs_per_host", [
+    ("v4-8", 4, "2x2x1", 1, 4),
+    ("v4-32", 16, "2x2x4", 4, 4),
+    ("v5p-8", 4, "2x2x1", 1, 4),
+    ("v5p-32", 16, "2x2x4", 4, 4),
+    ("v5p-128", 64, "4x4x4", 16, 4),
+    ("v5e-4", 4, "2x2", 1, 4),
+    ("v5e-8", 8, "2x4", 1, 8),
+    ("v5e-16", 16, "4x4", 2, 8),
+    ("v6e-64", 64, "8x8", 8, 8),
+    ("v3-32", 16, "4x4", 4, 4),
+])
+def test_parse_accelerator(accel, chips, topo, hosts, devs_per_host):
+    t = parse_accelerator(accel)
+    assert t.chips == chips
+    assert t.topology_str == topo
+    assert t.hosts_per_slice == hosts
+    assert t.devices_per_host == devs_per_host
+
+
+def test_explicit_topology_override():
+    t = parse_accelerator("v5e-16", topology="2x8")
+    assert t.topology == (2, 8)
+
+
+def test_topology_product_mismatch_rejected():
+    with pytest.raises(ValueError, match="topology"):
+        parse_accelerator("v5e-16", topology="4x8")
+
+
+def test_multislice_counts():
+    t = parse_accelerator("v5p-32", num_slices=4)
+    assert t.num_hosts == 16
+    assert t.total_chips == 64
+
+
+def test_unknown_generation():
+    with pytest.raises(ValueError, match="unknown TPU generation"):
+        parse_accelerator("v99-8")
+
+
+# ---------------------------------------------------------------------------
+# Cluster spec goldens (reference TestClusterSpec, pod_test.go:230)
+# ---------------------------------------------------------------------------
+
+def make_job(**kw):
+    job = testutil.new_tpujob(name="test-cluster-spec", **kw)
+    set_defaults(job)
+    return job
+
+
+def test_cluster_spec_golden_worker_ps():
+    job = make_job(worker=1, ps=2)
+    spec = build_cluster_spec(job, "worker", 0, domain="")
+    assert json.loads(spec.to_json()) == {
+        "cluster": {
+            "ps": ["test-cluster-spec-ps-0.default.svc:8470",
+                   "test-cluster-spec-ps-1.default.svc:8470"],
+            "worker": ["test-cluster-spec-worker-0.default.svc:8470"],
+        },
+        "task": {"type": "worker", "index": 0},
+        "environment": "cloud",
+    }
+
+
+def test_cluster_spec_custom_domain():
+    # Reference: EnvCustomClusterDomain variants in TestClusterSpec.
+    job = make_job(worker=1)
+    spec = build_cluster_spec(job, "worker", 0, domain="cluster.local")
+    assert spec.cluster["worker"] == [
+        "test-cluster-spec-worker-0.default.svc.cluster.local:8470"]
+
+
+def test_sparse_cluster_spec_for_elastic_worker():
+    # Reference SparseClusterSpec (tensorflow.go:64-83): the worker sees
+    # itself + all PS only.
+    job = make_job(worker=3, ps=2, chief=1)
+    job.spec.enable_elastic_worker = True
+    spec = build_cluster_spec(job, "worker", 1, domain="")
+    assert set(spec.cluster) == {"ps", "worker"}
+    assert spec.cluster["worker"] == ["test-cluster-spec-worker-1.default.svc:8470"]
+    assert len(spec.cluster["ps"]) == 2
+    # chief still sees the dense view
+    dense = build_cluster_spec(job, "chief", 0, domain="")
+    assert set(dense.cluster) == {"chief", "ps", "worker"}
+    assert len(dense.cluster["worker"]) == 3
+
+
+def test_custom_port_respected():
+    job = make_job(worker=2)
+    from tf_operator_tpu.api import constants
+
+    job.spec.replica_specs["worker"].template.spec.containers[0].ports[
+        constants.DEFAULT_PORT_NAME] = 9999
+    spec = build_cluster_spec(job, "worker", 0, domain="")
+    assert spec.cluster["worker"][0].endswith(":9999")
+
+
+# ---------------------------------------------------------------------------
+# Worker env rendering (TF_CONFIG replacement)
+# ---------------------------------------------------------------------------
+
+def test_process_ranks_chief_first():
+    job = make_job(worker=4, chief=1, ps=2)
+    ranks = process_ranks(job)
+    assert ranks["chief"] == [0]
+    assert ranks["worker"] == [1, 2, 3, 4]
+    assert "ps" not in ranks
+
+
+def test_render_env_golden():
+    job = make_job(worker=2, chief=1, accelerator="v5p-32")
+    env = render_worker_env(job, "worker", 1, domain="")
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-32"
+    assert env["TPU_TOPOLOGY"] == "2x2x4"
+    assert env["JAX_COORDINATOR_ADDRESS"] == \
+        "test-cluster-spec-chief-0.default.svc:8476"
+    assert env["JAX_NUM_PROCESSES"] == "3"
+    assert env["JAX_PROCESS_ID"] == "2"
+    assert env["TPU_WORKER_ID"] == "2"
+    assert env["TPU_WORKER_HOSTNAMES"] == (
+        "test-cluster-spec-chief-0.default.svc,"
+        "test-cluster-spec-worker-0.default.svc,"
+        "test-cluster-spec-worker-1.default.svc")
+    cluster = json.loads(env["TPUJOB_CLUSTER_SPEC"])
+    assert cluster["task"] == {"type": "worker", "index": 1}
+    assert "MEGASCALE_NUM_SLICES" not in env
+
+
+def test_render_env_multislice():
+    job = make_job(worker=8, accelerator="v5p-32")
+    job.spec.slice.num_slices = 2
+    env = render_worker_env(job, "worker", 5, domain="")
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    # v5p-32 = 4 hosts/slice; rank 5 -> slice 1
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == env["JAX_COORDINATOR_ADDRESS"]
+
+
+def test_render_env_multislice_with_chief_offset():
+    # Regression: slice id comes from the worker index, not the global rank
+    # (a chief offsets ranks by one but is not a slice host).
+    job = make_job(worker=8, chief=1, accelerator="v5p-32")
+    job.spec.slice.num_slices = 2
+    env = render_worker_env(job, "worker", 3, domain="")
+    assert env["JAX_PROCESS_ID"] == "4"  # chief is rank 0
+    assert env["MEGASCALE_SLICE_ID"] == "0"  # worker 3 is in slice 0
+    env7 = render_worker_env(job, "worker", 7, domain="")
+    assert env7["MEGASCALE_SLICE_ID"] == "1"
+
+
+def test_out_of_range_index_gets_unique_rank():
+    # Elastic scale-up transient: index beyond spec.replicas must not
+    # collide with an existing process id.
+    job = make_job(worker=2, chief=1)
+    env = render_worker_env(job, "worker", 2, domain="")
+    assert env["JAX_PROCESS_ID"] == "3"
+    assert int(env["JAX_NUM_PROCESSES"]) >= 4
+
+
+def test_single_process_job_gets_no_cluster_env():
+    # Reference isDistributed (pod.go:296-317): single-process jobs get no
+    # TF_CONFIG; here no JAX_*/cluster-spec env.
+    job = make_job(worker=1, accelerator="v5e-4")
+    assert not is_distributed(job)
+    env = render_worker_env(job, "worker", 0, domain="")
+    assert "JAX_COORDINATOR_ADDRESS" not in env
+    assert "TPUJOB_CLUSTER_SPEC" not in env
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5e-4"
+
+
+def test_coordinator_is_worker0_when_chiefless():
+    job = make_job(worker=2)
+    assert coordinator_address(job, domain="") == \
+        "test-cluster-spec-worker-0.default.svc:8476"
+
+
+def test_ps_gets_cluster_spec_but_no_jax_rank():
+    job = make_job(worker=2, ps=1)
+    env = render_worker_env(job, "ps", 0, domain="")
+    assert "TPUJOB_CLUSTER_SPEC" in env
+    assert "JAX_PROCESS_ID" not in env

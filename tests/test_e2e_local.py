@@ -1,0 +1,276 @@
+"""Hermetic end-to-end tests: real controller loop + subprocess data plane.
+
+Reference analog: the Python e2e suites under py/kubeflow/tf_operator/
+(simple_tfjob_tests, replica_restart_policy_tests, shutdown_policy_tests,
+invalid_tfjob_tests, cleanpod_policy_tests) driven against a live cluster
+with the test-server payload; here the whole stack runs in-process with
+subprocess pods and the file-based worker stub.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from tf_operator_tpu import testutil
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import (
+    Container,
+    JobConditionType,
+    PodSpec,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    TPUJob,
+    TPUJobSpec,
+    ObjectMeta,
+)
+from tf_operator_tpu.operator import Operator
+from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.local import LocalProcessBackend
+from tf_operator_tpu.sdk import TPUJobClient
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def stub_command(*args):
+    return [sys.executable, "-m", "tf_operator_tpu.runtime.worker_stub",
+            *args]
+
+
+def stub_job(name, stub_dir, worker=1, args=(), restart_policy="",
+             chief=0, accelerator=""):
+    def spec(n):
+        return ReplicaSpec(
+            replicas=n,
+            restart_policy=restart_policy,
+            template=PodTemplateSpec(spec=PodSpec(containers=[Container(
+                name=constants.DEFAULT_CONTAINER_NAME,
+                command=stub_command(*args),
+                env={"TPUJOB_STUB_DIR": stub_dir},
+            )])))
+
+    replica_specs = {"worker": spec(worker)}
+    if chief:
+        replica_specs["chief"] = spec(chief)
+    job = TPUJob(metadata=ObjectMeta(name=name),
+                 spec=TPUJobSpec(replica_specs=replica_specs))
+    if accelerator:
+        job.spec.slice.accelerator = accelerator
+    return job
+
+
+@pytest.fixture
+def operator(tmp_path):
+    backend = LocalProcessBackend(
+        store=None,  # filled below
+        workdir=REPO_ROOT,
+        extra_env={"PYTHONPATH": REPO_ROOT + os.pathsep
+                   + os.environ.get("PYTHONPATH", "")},
+    )
+    op = Operator(backend=backend)
+    backend.store = op.store
+    op.start(threadiness=2)
+    yield op
+    op.stop()
+
+
+@pytest.fixture
+def client(operator):
+    return TPUJobClient(operator.store)
+
+
+def tell(stub_dir, pod_name, command):
+    os.makedirs(stub_dir, exist_ok=True)
+    # Atomic write: the stub polls concurrently.
+    tmp = os.path.join(stub_dir, f".{pod_name}.cmd.tmp")
+    with open(tmp, "w") as f:
+        f.write(command)
+    os.replace(tmp, os.path.join(stub_dir, f"{pod_name}.cmd"))
+
+
+def wait_for(predicate, timeout=15.0, interval=0.05, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = predicate()
+        if result:
+            return result
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_simple_job_lifecycle(operator, client, tmp_path):
+    """simple_tfjob_tests analog: create -> Running -> Succeeded; no
+    creation-failure events; bootstrap env visible to every replica."""
+    stub_dir = str(tmp_path / "stub")
+    client.create(stub_job("smoke", stub_dir, worker=2,
+                           args=("--exit-after", "0.5")))
+    client.wait_for_condition("smoke", JobConditionType.RUNNING, timeout=10)
+    # endpoints exist per replica while the job runs (they may be reaped
+    # with their pods once worker-0's completion ends the job)
+    wait_for(lambda: sorted(
+        e.metadata.name for e in operator.store.list(store_mod.ENDPOINTS)) ==
+        ["smoke-worker-0", "smoke-worker-1"], message="both endpoints")
+    job = client.wait_for_job("smoke", timeout=15)
+    assert testutil.check_condition(job, JobConditionType.SUCCEEDED)
+    assert not operator.recorder.events_for(reason="FailedCreatePod")
+
+    # env snapshots: both workers saw their identity + full cluster view
+    for idx in (0, 1):
+        with open(os.path.join(stub_dir, f"smoke-worker-{idx}.env.json")) as f:
+            snap = json.load(f)
+        assert snap["TPU_WORKER_ID"] == str(idx)
+        assert snap["JAX_NUM_PROCESSES"] == "2"
+        assert snap["JAX_COORDINATOR_ADDRESS"].startswith("127.0.0.1:")
+        cluster = json.loads(snap["TPUJOB_CLUSTER_SPEC"])
+        assert cluster["task"] == {"type": "worker", "index": idx}
+        assert len(cluster["cluster"]["worker"]) == 2
+
+
+def test_pod_names_contract(operator, client, tmp_path):
+    """pod_names_validation_tests analog: {job}-{type}-{index}."""
+    stub_dir = str(tmp_path / "stub")
+    client.create(stub_job("names", stub_dir, worker=2, chief=1,
+                           args=("--exit-after", "0.4")))
+    wait_for(lambda: len(client.get_pod_names("names")) == 3,
+             message="3 pods")
+    assert client.get_pod_names("names") == [
+        "names-chief-0", "names-worker-0", "names-worker-1"]
+    assert client.get_pod_names("names", replica_type="chief") == ["names-chief-0"]
+    client.wait_for_job("names", timeout=15)
+
+
+def test_restart_policy_exit_code_retryable(operator, client, tmp_path):
+    """replica_restart_policy_tests analog: retryable exit -> same-identity
+    restart (new pod uid, same name), then clean completion."""
+    stub_dir = str(tmp_path / "stub")
+    client.create(stub_job("restart", stub_dir, worker=2,
+                           restart_policy=RestartPolicy.EXIT_CODE))
+    client.wait_for_condition("restart", JobConditionType.RUNNING, timeout=10)
+
+    pods = {p.metadata.name: p for p in client.get_pods("restart")}
+    old_uid = pods["restart-worker-1"].metadata.uid
+
+    tell(stub_dir, "restart-worker-1", "exit:137")  # SIGKILL-class: retryable
+
+    def restarted():
+        for p in client.get_pods("restart"):
+            if (p.metadata.name == "restart-worker-1"
+                    and p.metadata.uid != old_uid):
+                return p
+        return None
+
+    wait_for(restarted, message="worker-1 restart with fresh uid")
+    job = client.get("restart")
+    assert not testutil.get_condition(job, JobConditionType.FAILED)
+
+    # drive both workers to success
+    wait_for(lambda: all(p.status.phase == "Running"
+                         for p in client.get_pods("restart")),
+             message="both running again")
+    tell(stub_dir, "restart-worker-0", "exit:0")
+    tell(stub_dir, "restart-worker-1", "exit:0")
+    job = client.wait_for_job("restart", timeout=15)
+    assert testutil.check_condition(job, JobConditionType.SUCCEEDED)
+
+
+def test_restart_policy_exit_code_permanent(operator, client, tmp_path):
+    """Permanent exit code under ExitCode policy -> job Failed, no restart."""
+    stub_dir = str(tmp_path / "stub")
+    client.create(stub_job("permfail", stub_dir, worker=1,
+                           restart_policy=RestartPolicy.EXIT_CODE))
+    client.wait_for_condition("permfail", JobConditionType.RUNNING, timeout=10)
+    tell(stub_dir, "permfail-worker-0", "exit:1")
+    job = client.wait_for_job("permfail", timeout=15)
+    assert testutil.check_condition(job, JobConditionType.FAILED)
+
+
+def test_shutdown_policy_chief(operator, client, tmp_path):
+    """shutdown_policy_tests analog: chief completing ends the job even
+    with workers still running; running workers are cleaned up."""
+    stub_dir = str(tmp_path / "stub")
+    client.create(stub_job("chiefdone", stub_dir, worker=2, chief=1))
+    client.wait_for_condition("chiefdone", JobConditionType.RUNNING, timeout=10)
+    tell(stub_dir, "chiefdone-chief-0", "exit:0")
+    job = client.wait_for_job("chiefdone", timeout=15)
+    assert testutil.check_condition(job, JobConditionType.SUCCEEDED)
+    # CleanPodPolicy default Running: worker pods deleted after finish
+    wait_for(lambda: client.get_pod_names("chiefdone", replica_type="worker") == [],
+             message="workers cleaned up")
+
+
+def test_invalid_job_marked_failed(operator, client, tmp_path):
+    """invalid_tfjob_tests analog: bad spec -> Failed condition, no pods."""
+    job = stub_job("badjob", str(tmp_path), worker=1)
+    job.spec.replica_specs["worker"].template.spec.containers[0].name = "oops"
+    client.create(job)
+    failed = client.wait_for_condition("badjob", JobConditionType.FAILED,
+                                       timeout=10)
+    assert failed.status.conditions[-1].reason == "InvalidTPUJobSpec"
+    assert client.get_pod_names("badjob") == []
+
+
+def test_scale_down_live_job(operator, client, tmp_path):
+    """Dynamic scale-down: replicas 3 -> 1 deletes out-of-range pods."""
+    stub_dir = str(tmp_path / "stub")
+    client.create(stub_job("scale", stub_dir, worker=3))
+    wait_for(lambda: len(client.get_pod_names("scale")) == 3, message="3 pods")
+
+    def shrink(job):
+        job.spec.replica_specs["worker"].replicas = 1
+
+    client.patch("scale", shrink)
+    wait_for(lambda: client.get_pod_names("scale") == ["scale-worker-0"],
+             message="scale down to worker-0")
+    tell(stub_dir, "scale-worker-0", "exit:0")
+    client.wait_for_job("scale", timeout=15)
+
+
+def test_job_deletion_cascades_to_pods(operator, client, tmp_path):
+    """Deleting a TPUJob reaps owned pods (ownerReference GC analog) and
+    terminates their processes."""
+    stub_dir = str(tmp_path / "stub")
+    client.create(stub_job("reap", stub_dir, worker=2))
+    client.wait_for_condition("reap", JobConditionType.RUNNING, timeout=10)
+    client.delete("reap")
+    client.wait_for_delete("reap", timeout=10)
+    wait_for(lambda: client.get_pod_names("reap") == [],
+             message="owned pods garbage-collected")
+    assert operator.store.list(store_mod.ENDPOINTS) == []
+
+
+def test_gang_scheduling_capacity_gate(tmp_path):
+    """Gang admission: with capacity for one v5e-8 slice, the second job's
+    pods stay Pending until the first finishes."""
+    backend = LocalProcessBackend(
+        store=None, workdir=REPO_ROOT,
+        extra_env={"PYTHONPATH": REPO_ROOT + os.pathsep
+                   + os.environ.get("PYTHONPATH", "")})
+    op = Operator(backend=backend, enable_gang_scheduling=True, total_chips=8)
+    backend.store = op.store
+    op.start(threadiness=2)
+    try:
+        client = TPUJobClient(op.store)
+        stub_dir = str(tmp_path / "stub")
+        client.create(stub_job("gang-a", stub_dir, worker=1,
+                               accelerator="v5e-8"))
+        client.wait_for_condition("gang-a", JobConditionType.RUNNING,
+                                  timeout=10)
+        client.create(stub_job("gang-b", stub_dir, worker=1,
+                               accelerator="v5e-8",
+                               args=("--exit-after", "0.3")))
+        time.sleep(0.6)
+        pods_b = client.get_pods("gang-b")
+        assert pods_b and all(p.status.phase == "Pending" for p in pods_b), \
+            "gang-b must be gated while gang-a holds the slice"
+        tell(stub_dir, "gang-a-worker-0", "exit:0")
+        client.wait_for_job("gang-a", timeout=15)
+        job_b = client.wait_for_job("gang-b", timeout=15)
+        assert testutil.check_condition(job_b, JobConditionType.SUCCEEDED)
+    finally:
+        op.stop()

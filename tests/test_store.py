@@ -1,0 +1,93 @@
+"""Store (API-server analog) tests."""
+
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu import testutil
+from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.store import Store
+
+
+def test_create_assigns_identity():
+    s = Store()
+    job = testutil.new_tpujob(worker=1)
+    job.metadata.uid = ""
+    job.metadata.creation_timestamp = None
+    created = s.create(store_mod.TPUJOBS, job)
+    assert created.metadata.uid
+    assert created.metadata.creation_timestamp is not None
+    assert created.metadata.resource_version > 0
+
+
+def test_double_create_rejected():
+    s = Store()
+    s.create(store_mod.TPUJOBS, testutil.new_tpujob(worker=1))
+    with pytest.raises(store_mod.AlreadyExistsError):
+        s.create(store_mod.TPUJOBS, testutil.new_tpujob(worker=1))
+
+
+def test_update_conflict_on_stale_rv():
+    s = Store()
+    created = s.create(store_mod.TPUJOBS, testutil.new_tpujob(worker=1))
+    fresh = s.get(store_mod.TPUJOBS, "default", created.metadata.name)
+    s.update(store_mod.TPUJOBS, fresh)  # bumps rv
+    with pytest.raises(store_mod.ConflictError):
+        s.update(store_mod.TPUJOBS, created)  # stale rv
+
+
+def test_update_status_merges_only_status():
+    s = Store()
+    created = s.create(store_mod.TPUJOBS, testutil.new_tpujob(worker=2))
+    stale = created.deepcopy()
+    stale.spec.replica_specs["worker"].replicas = 99  # must NOT land
+    from tf_operator_tpu.api.types import ReplicaStatus
+
+    stale.status.replica_statuses["worker"] = ReplicaStatus(active=2)
+    s.update_status(store_mod.TPUJOBS, stale)
+    stored = s.get(store_mod.TPUJOBS, "default", created.metadata.name)
+    assert stored.spec.replica_specs["worker"].replicas == 2
+    assert stored.status.replica_statuses["worker"].active == 2
+
+
+def test_list_with_selector():
+    s = Store()
+    job = testutil.new_tpujob(worker=2)
+    for i in range(2):
+        s.create(store_mod.PODS, testutil.new_pod(job, "worker", i))
+    s.create(store_mod.PODS, testutil.new_pod(job, "ps", 0))
+    from tf_operator_tpu.api import constants
+
+    out = s.list(store_mod.PODS, namespace="default",
+                 selector={constants.LABEL_REPLICA_TYPE: "worker"})
+    assert len(out) == 2
+
+
+def test_watch_delivers_events_and_replay():
+    s = Store()
+    job = testutil.new_tpujob(worker=1)
+    s.create(store_mod.TPUJOBS, job)
+    events = []
+    done = threading.Event()
+
+    def handler(etype, obj):
+        events.append((etype, obj.metadata.name))
+        if len(events) >= 3:
+            done.set()
+
+    s.watch(store_mod.TPUJOBS, handler, replay=True)
+    s.update_status(store_mod.TPUJOBS, job)
+    s.delete(store_mod.TPUJOBS, "default", job.metadata.name)
+    assert done.wait(2.0)
+    assert events[0][0] == store_mod.ADDED
+    assert events[1][0] == store_mod.MODIFIED
+    assert events[2][0] == store_mod.DELETED
+
+
+def test_mutating_returned_object_does_not_affect_store():
+    s = Store()
+    created = s.create(store_mod.TPUJOBS, testutil.new_tpujob(worker=1))
+    created.spec.replica_specs["worker"].replicas = 42
+    stored = s.get(store_mod.TPUJOBS, "default", created.metadata.name)
+    assert stored.spec.replica_specs["worker"].replicas == 1

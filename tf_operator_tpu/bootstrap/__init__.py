@@ -1,0 +1,13 @@
+"""Cluster bootstrap: slice topology -> worker ranks -> env injection.
+
+Reference parity: pkg/controller.v1/tensorflow/tensorflow.go (TF_CONFIG
+rendering) replaced by jax.distributed / libtpu-style env
+(TPU_WORKER_ID, TPU_WORKER_HOSTNAMES, coordinator address, megascale).
+"""
+
+from tf_operator_tpu.bootstrap.topology import SliceTopology, parse_accelerator  # noqa: F401
+from tf_operator_tpu.bootstrap.cluster import (  # noqa: F401
+    ClusterSpec,
+    build_cluster_spec,
+    render_worker_env,
+)

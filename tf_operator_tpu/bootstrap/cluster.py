@@ -1,0 +1,212 @@
+"""Cluster-spec construction and worker env rendering.
+
+This is the heart of distributed bootstrap — the TPU-native replacement
+for the reference's TF_CONFIG machinery
+(pkg/controller.v1/tensorflow/tensorflow.go:97-173, pod.go:259-317):
+
+- replica DNS naming keeps the reference contract
+  ``{job}-{rtype}-{index}.{ns}.svc[.{domain}]`` (tensorflow.go:154-166).
+- instead of TF_CONFIG the default container receives:
+  * ``TPUJOB_CLUSTER_SPEC`` — full cluster JSON (same shape as TF_CONFIG:
+    cluster/task/environment) for tooling and e2e golden tests;
+  * ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` — libtpu-style slice
+    bootstrap;
+  * ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID`` — jax.distributed.initialize() bootstrap; the
+    coordinator is the chief (or worker-0) at a dedicated port;
+  * ``TPU_ACCELERATOR_TYPE`` / ``TPU_TOPOLOGY`` — slice shape for mesh
+    construction;
+  * ``MEGASCALE_*`` — multislice (DCN) coordination when numSlices > 1.
+- elastic mode renders a sparse cluster view (reference SparseClusterSpec,
+  tensorflow.go:64-83): the worker sees itself plus parameter servers, so
+  membership can change without restarting the world.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import (
+    ReplicaType,
+    TPUJob,
+    gen_general_name,
+    is_chief_or_master,
+)
+from tf_operator_tpu.bootstrap.topology import SliceTopology, parse_accelerator
+
+# Replica-type ordering inside cluster specs and rank assignment: the
+# coordinator-capable types come first so process 0 is always chief-like.
+_RANKED_TYPES = (ReplicaType.CHIEF, ReplicaType.MASTER, ReplicaType.WORKER)
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    """Full cluster view for one task (TF_CONFIG-shaped parity artifact)."""
+
+    cluster: Dict[str, List[str]]
+    task_type: str
+    task_index: int
+    environment: str = "cloud"
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "cluster": self.cluster,
+            "task": {"type": self.task_type, "index": self.task_index},
+            "environment": self.environment,
+        }, sort_keys=True)
+
+
+def replica_dns_name(job: TPUJob, rtype: str, index: int,
+                     domain: str = "") -> str:
+    """Reference naming contract (tensorflow.go:154-166)."""
+    name = gen_general_name(job.metadata.name, rtype, index)
+    host = f"{name}.{job.metadata.namespace}.svc"
+    if domain:
+        host = f"{host}.{domain}"
+    return host
+
+
+def replica_port(job: TPUJob, rtype: str) -> int:
+    """Rendezvous port from the default container's named port (reference
+    GetPortFromTFJob, tensorflow/util.go:28-43)."""
+    spec = job.spec.replica_specs.get(rtype)
+    if spec is not None:
+        container = spec.template.spec.container(constants.DEFAULT_CONTAINER_NAME)
+        if container is not None:
+            port = container.ports.get(constants.DEFAULT_PORT_NAME)
+            if port:
+                return port
+    return constants.DEFAULT_PORT
+
+
+def is_distributed(job: TPUJob) -> bool:
+    """More than one process in the cluster (reference isDistributed,
+    pod.go:296-317)."""
+    total = sum(s.replicas or 0 for s in job.spec.replica_specs.values())
+    return total > 1
+
+
+def _cluster_domain() -> str:
+    return os.environ.get(constants.ENV_CUSTOM_CLUSTER_DOMAIN, "")
+
+
+def build_cluster_spec(job: TPUJob, rtype: str, index: int,
+                       domain: Optional[str] = None) -> ClusterSpec:
+    """Build the cluster view task (rtype, index) should see.
+
+    Dense mode lists every replica of every type (reference
+    genClusterSpec, tensorflow.go:142-173). Elastic mode is sparse: the
+    worker sees only itself plus all PS replicas (reference
+    SparseClusterSpec, tensorflow.go:64-83); non-worker types see the
+    dense view.
+    """
+    if domain is None:
+        domain = _cluster_domain()
+    rt = rtype.lower()
+    sparse = (job.spec.enable_elastic_worker and rt == ReplicaType.WORKER)
+
+    cluster: Dict[str, List[str]] = {}
+    for repl_type, spec in sorted(job.spec.replica_specs.items()):
+        port = replica_port(job, repl_type)
+        n = spec.replicas or 0
+        if sparse and repl_type not in (ReplicaType.PS, rt):
+            continue
+        if sparse and repl_type == rt:
+            # Sparse: only this worker's own entry, keyed by its index.
+            cluster[repl_type] = [
+                f"{replica_dns_name(job, repl_type, index, domain)}:{port}"]
+        else:
+            cluster[repl_type] = [
+                f"{replica_dns_name(job, repl_type, i, domain)}:{port}"
+                for i in range(n)]
+    return ClusterSpec(cluster=cluster, task_type=rt, task_index=index)
+
+
+def process_ranks(job: TPUJob) -> Dict[str, List[int]]:
+    """Global jax.distributed process ids for the data-plane types
+    (chief/master first, then workers). PS/evaluator replicas are not jax
+    processes; they keep cluster-spec entries only."""
+    ranks: Dict[str, List[int]] = {}
+    next_rank = 0
+    for rtype in _RANKED_TYPES:
+        spec = job.spec.replica_specs.get(rtype)
+        if spec is None:
+            continue
+        n = spec.replicas or 0
+        ranks[rtype] = list(range(next_rank, next_rank + n))
+        next_rank += n
+    return ranks
+
+
+def coordinator_address(job: TPUJob, domain: Optional[str] = None) -> str:
+    """Process-0's address for jax.distributed.initialize: the chief/master
+    when present, else worker-0, on the coordinator port."""
+    if domain is None:
+        domain = _cluster_domain()
+    for rtype in _RANKED_TYPES:
+        if rtype in job.spec.replica_specs:
+            host = replica_dns_name(job, rtype, 0, domain)
+            return f"{host}:{constants.DEFAULT_COORDINATOR_PORT}"
+    raise ValueError(f"job {job.key()} has no coordinator-capable replica type")
+
+
+def render_worker_env(job: TPUJob, rtype: str, index: int,
+                      domain: Optional[str] = None) -> Dict[str, str]:
+    """Env the engine injects into the default container at pod-create time
+    (the SetClusterSpec plugin hook)."""
+    if domain is None:
+        domain = _cluster_domain()
+    rt = rtype.lower()
+    env: Dict[str, str] = {}
+
+    sl = job.spec.slice
+    topo: Optional[SliceTopology] = None
+    if sl.accelerator:
+        topo = parse_accelerator(sl.accelerator, sl.topology, sl.num_slices)
+        env["TPU_ACCELERATOR_TYPE"] = topo.accelerator
+        env["TPU_TOPOLOGY"] = topo.topology_str
+
+    if not is_distributed(job):
+        return env
+
+    env["TPUJOB_CLUSTER_SPEC"] = build_cluster_spec(job, rt, index, domain).to_json()
+
+    ranks = process_ranks(job)
+    num_processes = sum(len(v) for v in ranks.values())
+    if rt in ranks and num_processes > 0:
+        if index < len(ranks[rt]):
+            rank = ranks[rt][index]
+        else:
+            # Transient out-of-range render (elastic scale-up before the
+            # spec settles): offset by the type's base rank and widen the
+            # process count so the id is unique and in range.
+            base = ranks[rt][0] if ranks[rt] else num_processes
+            rank = base + index
+            num_processes = max(num_processes, rank + 1)
+        env["JAX_COORDINATOR_ADDRESS"] = coordinator_address(job, domain)
+        env["JAX_NUM_PROCESSES"] = str(num_processes)
+        env["JAX_PROCESS_ID"] = str(rank)
+        env["TPU_WORKER_ID"] = str(rank)
+        hostnames = []
+        for t in _RANKED_TYPES:
+            spec = job.spec.replica_specs.get(t)
+            for i in range(spec.replicas or 0) if spec else ():
+                hostnames.append(replica_dns_name(job, t, i, domain))
+        env["TPU_WORKER_HOSTNAMES"] = ",".join(hostnames)
+
+        if topo is not None and topo.num_slices > 1:
+            # Multislice (DCN) coordination, megascale-style. Slice hosts
+            # are the *workers*, assigned slice-major by worker index — a
+            # chief/master offsets the global rank but is not a slice host,
+            # so the slice id must come from the worker index, not the rank.
+            worker_pos = index if rt == ReplicaType.WORKER else 0
+            env["MEGASCALE_COORDINATOR_ADDRESS"] = env["JAX_COORDINATOR_ADDRESS"]
+            env["MEGASCALE_NUM_SLICES"] = str(topo.num_slices)
+            env["MEGASCALE_SLICE_ID"] = str(
+                worker_pos // max(1, topo.hosts_per_slice))
+
+    return env

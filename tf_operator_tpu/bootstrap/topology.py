@@ -1,0 +1,124 @@
+"""TPU slice topology model.
+
+No reference analog — the reference was device-blind (Volcano PodGroups
+carry only counts/resources). TPU-native orchestration needs the slice
+shape to (a) compute process counts/ranks for jax.distributed, (b) derive
+the default ICI mesh for GSPMD sharding, (c) gang-allocate whole slices.
+
+Conventions encoded (public Cloud TPU naming):
+- v2/v3/v4/v5p accelerator names count TensorCores; v5e/v6e names count
+  chips (v4/v5p are "megacore": 2 cores/chip presented as one device).
+- chips per host: v2/v3 -> 4, v4/v5p -> 4, v5e/v6e -> 8 (capped by slice
+  size for sub-host slices).
+- ICI mesh: 3D torus for v4/v5p (e.g. v5p-32 = 16 chips = 2x2x4),
+  2D for v2/v3/v5e/v6e (e.g. v5e-16 = 4x4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Tuple
+
+_ACCEL_RE = re.compile(r"^(v[0-9]+[a-z]*)-([0-9]+)$")
+
+# generation -> (name counts cores?, chips per host, ici mesh rank)
+_GENERATIONS = {
+    "v2": (True, 4, 2),
+    "v3": (True, 4, 2),
+    "v4": (True, 4, 3),
+    "v5p": (True, 4, 3),
+    "v5e": (False, 8, 2),
+    "v5litepod": (False, 8, 2),
+    "v6e": (False, 8, 2),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    accelerator: str          # e.g. "v5p-32"
+    generation: str           # e.g. "v5p"
+    chips: int                # physical chips in one slice
+    topology: Tuple[int, ...]  # ICI mesh, e.g. (2, 2, 4)
+    chips_per_host: int
+    num_slices: int = 1
+
+    @property
+    def hosts_per_slice(self) -> int:
+        return max(1, self.chips // self.chips_per_host)
+
+    @property
+    def num_hosts(self) -> int:
+        """Total worker processes across all slices (one per host)."""
+        return self.hosts_per_slice * self.num_slices
+
+    @property
+    def devices_per_host(self) -> int:
+        return min(self.chips, self.chips_per_host)
+
+    @property
+    def total_chips(self) -> int:
+        return self.chips * self.num_slices
+
+    @property
+    def topology_str(self) -> str:
+        return "x".join(str(d) for d in self.topology)
+
+
+def _default_topology(chips: int, rank: int) -> Tuple[int, ...]:
+    """Factor ``chips`` into ``rank`` near-balanced power-of-two-ish dims,
+    sorted ascending (2x2x4 rather than 4x2x2)."""
+    if chips <= 0:
+        raise ValueError(f"chips must be positive, got {chips}")
+    dims = [1] * rank
+    remaining = chips
+    # Peel factors smallest-first so dims stay balanced.
+    while remaining > 1:
+        for factor in range(2, remaining + 1):
+            if remaining % factor == 0:
+                smallest = dims.index(min(dims))
+                dims[smallest] *= factor
+                remaining //= factor
+                break
+    # Cloud convention: non-trivial dims ascending, trailing 1s
+    # (v4-8 -> 2x2x1, v5p-32 -> 2x2x4).
+    non_trivial = sorted(d for d in dims if d > 1)
+    return tuple(non_trivial + [1] * (rank - len(non_trivial)))
+
+
+def parse_accelerator(accelerator: str, topology: str = "",
+                      num_slices: int = 1) -> SliceTopology:
+    """Parse a Cloud-TPU-style accelerator string into a SliceTopology.
+
+    ``topology`` overrides the derived ICI mesh (e.g. "4x4" for a twisted
+    v5e-16); its product must equal the chip count.
+    """
+    m = _ACCEL_RE.match(accelerator)
+    if not m:
+        raise ValueError(f"invalid accelerator {accelerator!r}; expected e.g. 'v5p-32'")
+    generation, count = m.group(1), int(m.group(2))
+    if generation not in _GENERATIONS:
+        raise ValueError(
+            f"unknown TPU generation {generation!r}; known: "
+            f"{', '.join(sorted(_GENERATIONS))}")
+    counts_cores, chips_per_host, rank = _GENERATIONS[generation]
+    chips = count // 2 if counts_cores else count
+    if chips < 1:
+        raise ValueError(f"accelerator {accelerator!r} resolves to zero chips")
+
+    if topology:
+        dims = tuple(int(d) for d in topology.split("x"))
+        if math.prod(dims) != chips:
+            raise ValueError(
+                f"topology {topology!r} has {math.prod(dims)} chips but "
+                f"{accelerator!r} has {chips}")
+    else:
+        dims = _default_topology(chips, rank)
+
+    if num_slices < 1:
+        raise ValueError("num_slices must be >= 1")
+
+    return SliceTopology(accelerator=accelerator, generation=generation,
+                         chips=chips, topology=dims,
+                         chips_per_host=chips_per_host, num_slices=num_slices)

@@ -1,0 +1,132 @@
+"""Gang scheduling: all-or-nothing SliceGroup admission.
+
+Reference parity: Volcano PodGroup sync (common/job_controller.go:218-322)
+and the gang annotations stamped on pods (tensorflow/pod.go:221-235).
+
+TPU-native difference: the gang unit is a *slice* — admission is
+all-or-nothing against whole-slice chip capacity, not per-pod resources.
+A SliceGroup carries minMember (pod gang) plus the slice shape; the
+scheduler admits groups FIFO when the cluster's chip budget fits the
+whole request (ICI slices are indivisible). The data-plane backend holds
+gang-scheduled pods in Pending until their group is admitted, which is
+exactly how Volcano gates pods.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import (
+    Pod,
+    ReplicaSpec,
+    SliceGroup,
+    SliceGroupSpec,
+    SliceGroupStatus,
+    TPUJob,
+)
+from tf_operator_tpu.controller.control import controller_owner_ref
+from tf_operator_tpu.controller.engine import GangScheduler
+from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.store import Store
+
+log = logging.getLogger("tpu_operator.gang")
+
+PHASE_PENDING = "Pending"
+PHASE_INQUEUE = "Inqueue"
+PHASE_RUNNING = "Running"
+
+
+def _chips_for(group: SliceGroup) -> int:
+    sl = group.spec.slice
+    if not sl.accelerator:
+        return 0
+    from tf_operator_tpu.bootstrap.topology import parse_accelerator
+
+    topo = parse_accelerator(sl.accelerator, sl.topology, max(1, sl.num_slices))
+    return topo.total_chips
+
+
+class SliceGangScheduler(GangScheduler):
+    """FIFO whole-slice admission. ``total_chips=None`` = unlimited capacity
+    (admission always succeeds, groups still tracked for observability)."""
+
+    def __init__(self, store: Store, total_chips: Optional[int] = None):
+        self.store = store
+        self.total_chips = total_chips
+        self._lock = threading.Lock()
+
+    # -- engine hooks ---------------------------------------------------
+
+    def sync_slice_group(self, job: TPUJob,
+                         replica_specs: Dict[str, ReplicaSpec]) -> None:
+        """Create/refresh the job's SliceGroup and run admission
+        (reference SyncPodGroup, job_controller.go:218-245)."""
+        total = sum(s.replicas or 0 for s in replica_specs.values())
+        min_member = total
+        queue = ""
+        priority = ""
+        sp = job.spec.run_policy.scheduling_policy
+        if sp is not None:
+            if sp.min_available is not None:
+                min_member = sp.min_available
+            queue = sp.queue
+            priority = sp.priority_class
+
+        desired_spec = SliceGroupSpec(min_member=min_member, queue=queue,
+                                      priority_class=priority,
+                                      slice=job.spec.slice.deepcopy())
+        existing = self.store.try_get(store_mod.SLICEGROUPS,
+                                      job.metadata.namespace,
+                                      job.metadata.name)
+        if existing is None:
+            group = SliceGroup(spec=desired_spec,
+                               status=SliceGroupStatus(phase=PHASE_PENDING))
+            group.metadata.name = job.metadata.name
+            group.metadata.namespace = job.metadata.namespace
+            group.metadata.labels = {constants.LABEL_JOB_NAME: job.metadata.name}
+            group.metadata.owner_references = [controller_owner_ref(job)]
+            self.store.create(store_mod.SLICEGROUPS, group)
+        elif existing.spec.to_dict() != desired_spec.to_dict():
+            existing.spec = desired_spec
+            self.store.update(store_mod.SLICEGROUPS, existing)
+        self._admit()
+
+    def delete_slice_group(self, job: TPUJob) -> None:
+        self.store.try_delete(store_mod.SLICEGROUPS, job.metadata.namespace,
+                              job.metadata.name)
+        self._admit()  # freed capacity may admit queued groups
+
+    def annotate_pod(self, job: TPUJob, pod: Pod, rtype: str) -> None:
+        """Reference: schedulerName + group-name + task-spec annotations
+        (tensorflow/pod.go:221-235)."""
+        if not pod.spec.scheduler_name:
+            pod.spec.scheduler_name = constants.DEFAULT_GANG_SCHEDULER
+        pod.metadata.annotations[constants.ANNOTATION_GANG_GROUP] = \
+            job.metadata.name
+        pod.metadata.annotations[constants.ANNOTATION_GANG_TASK] = rtype
+
+    # -- admission ------------------------------------------------------
+
+    def _admit(self) -> None:
+        """FIFO all-or-nothing: walk groups by creation order; admit while
+        the whole slice request fits the remaining chip budget."""
+        with self._lock:
+            groups = sorted(self.store.list(store_mod.SLICEGROUPS),
+                            key=lambda g: (g.metadata.creation_timestamp
+                                           or 0, g.metadata.name))
+            used = sum(_chips_for(g) for g in groups
+                       if g.status.phase in (PHASE_INQUEUE, PHASE_RUNNING))
+            for group in groups:
+                if group.status.phase in (PHASE_INQUEUE, PHASE_RUNNING):
+                    continue
+                need = _chips_for(group)
+                if self.total_chips is not None and used + need > self.total_chips:
+                    continue  # stays Pending; later groups may still fit
+                used += need
+                group.status.phase = PHASE_INQUEUE
+                self.store.update_status(store_mod.SLICEGROUPS, group)
+                log.info("admitted slice group %s (%d chips)",
+                         group.metadata.name, need)

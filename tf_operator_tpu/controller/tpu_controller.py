@@ -1,0 +1,370 @@
+"""TPUJobController: the concrete operator on top of the generic engine.
+
+Reference parity: pkg/controller.v1/tensorflow/controller.go (controller
+struct, worker loop, expectation gate, enqueue handlers), job.go (add/
+update handlers, invalid-spec failure), pod.go (adoption via
+ControllerRefManager), status.go (success policy) — wired to the
+process-native Store instead of the K8s API server.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.api import constants, set_defaults
+from tf_operator_tpu.api.types import (
+    Endpoint,
+    Pod,
+    ReplicaSpec,
+    TPUJob,
+    JobConditionType,
+)
+from tf_operator_tpu.api.validation import ValidationError, validate_job
+from tf_operator_tpu.bootstrap import render_worker_env
+from tf_operator_tpu.controller import conditions as cond
+from tf_operator_tpu.controller import status as status_mod
+from tf_operator_tpu.controller.control import (
+    EndpointControl,
+    PodControl,
+    controller_owner_ref,
+)
+from tf_operator_tpu.controller.engine import EngineConfig, JobEngine, JobPlugin
+from tf_operator_tpu.controller.expectations import (
+    ControllerExpectations,
+    expectation_key,
+)
+from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.events import (
+    EVENT_TYPE_NORMAL,
+    EVENT_TYPE_WARNING,
+    Recorder,
+)
+from tf_operator_tpu.runtime.store import ADDED, DELETED, MODIFIED, Store
+from tf_operator_tpu.runtime.workqueue import RateLimitingQueue, ShutDown
+
+log = logging.getLogger("tpu_operator.controller")
+
+CONTROLLER_NAME = "tpujob-controller"
+
+SUCCESSFUL_CREATE_POD_REASON = "SuccessfulCreatePod"
+FAILED_CREATE_POD_REASON = "FailedCreatePod"
+SUCCESSFUL_DELETE_POD_REASON = "SuccessfulDeletePod"
+FAILED_DELETE_POD_REASON = "FailedDeletePod"
+
+
+class StorePodControl(PodControl):
+    """RealPodControl analog (control/pod_control.go:66+): creates stamp
+    owner refs and emit success/failure events."""
+
+    def __init__(self, store: Store, recorder: Recorder):
+        self.store = store
+        self.recorder = recorder
+
+    def create_pod(self, namespace: str, pod: Pod, job: TPUJob) -> None:
+        pod.metadata.namespace = namespace
+        pod.metadata.owner_references = [controller_owner_ref(job)]
+        try:
+            self.store.create(store_mod.PODS, pod)
+        except Exception as e:
+            self.recorder.event(job, EVENT_TYPE_WARNING,
+                                FAILED_CREATE_POD_REASON,
+                                f"Error creating: {e}")
+            raise
+        self.recorder.event(job, EVENT_TYPE_NORMAL,
+                            SUCCESSFUL_CREATE_POD_REASON,
+                            f"Created pod: {pod.metadata.name}")
+
+    def delete_pod(self, namespace: str, name: str, job: TPUJob) -> None:
+        try:
+            self.store.delete(store_mod.PODS, namespace, name)
+        except store_mod.NotFoundError:
+            return  # already gone: deletion is level-triggered
+        except Exception as e:
+            self.recorder.event(job, EVENT_TYPE_WARNING,
+                                FAILED_DELETE_POD_REASON,
+                                f"Error deleting: {e}")
+            raise
+        self.recorder.event(job, EVENT_TYPE_NORMAL,
+                            SUCCESSFUL_DELETE_POD_REASON,
+                            f"Deleted pod: {name}")
+
+
+class StoreEndpointControl(EndpointControl):
+    def __init__(self, store: Store, recorder: Recorder):
+        self.store = store
+        self.recorder = recorder
+
+    def create_endpoint(self, namespace: str, endpoint: Endpoint,
+                        job: TPUJob) -> None:
+        endpoint.metadata.namespace = namespace
+        endpoint.metadata.owner_references = [controller_owner_ref(job)]
+        self.store.create(store_mod.ENDPOINTS, endpoint)
+
+    def delete_endpoint(self, namespace: str, name: str, job: TPUJob) -> None:
+        try:
+            self.store.delete(store_mod.ENDPOINTS, namespace, name)
+        except store_mod.NotFoundError:
+            pass
+
+
+class TPUJobController(JobPlugin):
+    def __init__(self, store: Store,
+                 recorder: Optional[Recorder] = None,
+                 config: Optional[EngineConfig] = None,
+                 gang=None,
+                 namespace: Optional[str] = None):
+        self.store = store
+        self.recorder = recorder or Recorder()
+        self.namespace = namespace  # None = all namespaces
+        self.workqueue = RateLimitingQueue()
+        self.expectations = ControllerExpectations()
+        self.engine = JobEngine(
+            plugin=self,
+            pod_control=StorePodControl(store, self.recorder),
+            endpoint_control=StoreEndpointControl(store, self.recorder),
+            recorder=self.recorder,
+            workqueue=self.workqueue,
+            expectations=self.expectations,
+            gang=gang,
+            config=config,
+        )
+        self._watchers = []
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------
+    # Informer handlers (reference controller.go:140-180, pod.go:73-214)
+    # ------------------------------------------------------------------
+
+    def start_watching(self) -> None:
+        self._watchers = [
+            self.store.watch(store_mod.TPUJOBS, self._on_job_event),
+            self.store.watch(store_mod.PODS, self._on_pod_event),
+            self.store.watch(store_mod.ENDPOINTS, self._on_endpoint_event),
+        ]
+
+    def _on_job_event(self, event_type: str, job: TPUJob) -> None:
+        if self.namespace and job.metadata.namespace != self.namespace:
+            return
+        if event_type == DELETED:
+            self.expectations.delete_for_job(job.key())
+            self._garbage_collect(job)
+        self.enqueue(job.key())
+
+    def _garbage_collect(self, job: TPUJob) -> None:
+        """Cascade-delete owned objects. The reference gets this for free
+        from the K8s ownerReference GC controller; the process-native store
+        has no GC, so the controller reaps owned pods/endpoints/slicegroups
+        when their job vanishes (pod deletion terminates the processes via
+        the backend's watch)."""
+        for kind in (store_mod.PODS, store_mod.ENDPOINTS,
+                     store_mod.SLICEGROUPS):
+            for obj in self.store.list(kind, namespace=job.metadata.namespace):
+                ref = obj.metadata.controller_ref()
+                if ref is not None and ref.uid == job.metadata.uid:
+                    self.store.try_delete(kind, obj.metadata.namespace,
+                                          obj.metadata.name)
+
+    def _resolve_job_key(self, obj) -> Optional[str]:
+        """Reference resolveControllerRef (job_controller.go:327-343):
+        kind + uid check against the live job."""
+        ref = obj.metadata.controller_ref()
+        if ref is None or ref.kind != constants.KIND:
+            return None
+        job = self.store.try_get(store_mod.TPUJOBS, obj.metadata.namespace,
+                                 ref.name)
+        if job is None or job.metadata.uid != ref.uid:
+            return None
+        return job.key()
+
+    def _on_pod_event(self, event_type: str, pod: Pod) -> None:
+        job_key = self._resolve_job_key(pod)
+        if job_key is None:
+            return
+        rtype = pod.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "")
+        key = expectation_key(job_key, "pods", rtype)
+        if event_type == ADDED:
+            self.expectations.creation_observed(key)
+        elif event_type == DELETED:
+            self.expectations.deletion_observed(key)
+        self.enqueue(job_key)
+
+    def _on_endpoint_event(self, event_type: str, ep: Endpoint) -> None:
+        job_key = self._resolve_job_key(ep)
+        if job_key is None:
+            return
+        rtype = ep.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "")
+        key = expectation_key(job_key, "endpoints", rtype)
+        if event_type == ADDED:
+            self.expectations.creation_observed(key)
+        elif event_type == DELETED:
+            self.expectations.deletion_observed(key)
+        self.enqueue(job_key)
+
+    def enqueue(self, job_key: str) -> None:
+        self.workqueue.add(job_key)
+
+    # ------------------------------------------------------------------
+    # Worker loop (reference controller.go:191-284)
+    # ------------------------------------------------------------------
+
+    def run(self, threadiness: int = 1) -> None:
+        self.start_watching()
+        for i in range(threadiness):
+            t = threading.Thread(target=self._worker, name=f"sync-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.workqueue.shutdown()
+        for w in self._watchers:
+            w.stop()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                key = self.workqueue.get(timeout=0.5)
+            except TimeoutError:
+                continue
+            except ShutDown:
+                return
+            try:
+                self.sync_tpujob(key)
+            except Exception:
+                log.exception("error syncing %s; requeueing", key)
+                self.workqueue.done(key)
+                self.workqueue.add_rate_limited(key)
+                continue
+            self.workqueue.done(key)
+            self.workqueue.forget(key)
+
+    def satisfied_expectations(self, job: TPUJob) -> bool:
+        """Reference satisfiedExpectations (controller.go:348-367): gate the
+        sync on every pods/endpoints expectation for the job."""
+        for rtype in job.spec.replica_specs:
+            for kind in ("pods", "endpoints"):
+                if not self.expectations.satisfied_expectations(
+                        expectation_key(job.key(), kind, rtype)):
+                    return False
+        return True
+
+    def sync_tpujob(self, key: str) -> None:
+        """Reference syncTFJob (controller.go:300-343)."""
+        namespace, name = key.split("/", 1)
+        job = self.store.try_get(store_mod.TPUJOBS, namespace, name)
+        if job is None:
+            log.info("job %s vanished; clearing expectations", key)
+            self.expectations.delete_for_job(key)
+            return
+
+        set_defaults(job)
+        try:
+            validate_job(job)
+        except ValidationError as e:
+            # Invalid spec -> Failed status, no requeue (reference
+            # job.go:87-135 writes Failed via the CRD REST client). Write
+            # only on change: an unconditional write fires MODIFIED ->
+            # re-enqueue -> write, a hot loop.
+            old_status = job.status.deepcopy()
+            msg = f"TPUJob {key} is not valid: {e}"
+            cond.update_job_conditions(job.status, JobConditionType.FAILED,
+                                       "InvalidTPUJobSpec", msg)
+            if job.status.to_dict() != old_status.to_dict():
+                self.recorder.event(job, EVENT_TYPE_WARNING, "InvalidTPUJob", msg)
+                self.update_job_status_in_api(job)
+            return
+
+        if not job.status.conditions:
+            msg = f"TPUJob {key} is created."
+            cond.update_job_conditions(job.status, JobConditionType.CREATED,
+                                       cond.JOB_CREATED_REASON, msg)
+
+        needs_sync = (job.spec.enable_elastic_worker
+                      or self.satisfied_expectations(job))
+        if not needs_sync:
+            log.debug("expectations pending for %s; skipping sync", key)
+            return
+        self.engine.reconcile_jobs(job)
+
+    # ------------------------------------------------------------------
+    # JobPlugin implementation (reference ControllerInterface)
+    # ------------------------------------------------------------------
+
+    def _base_selector(self, job: TPUJob) -> Dict[str, str]:
+        return {
+            constants.LABEL_GROUP_NAME: constants.GROUP,
+            constants.LABEL_JOB_NAME: job.metadata.name,
+        }
+
+    def get_pods_for_job(self, job: TPUJob) -> List[Pod]:
+        """List + adopt/release (reference GetPodsForJob common/pod.go:219-254
+        with ControllerRefManager claim semantics)."""
+        pods = self.store.list(store_mod.PODS,
+                               namespace=job.metadata.namespace,
+                               selector=self._base_selector(job))
+        return self._claim(store_mod.PODS, job, pods)
+
+    def get_endpoints_for_job(self, job: TPUJob) -> List[Endpoint]:
+        eps = self.store.list(store_mod.ENDPOINTS,
+                              namespace=job.metadata.namespace,
+                              selector=self._base_selector(job))
+        return self._claim(store_mod.ENDPOINTS, job, eps)
+
+    def _claim(self, kind: str, job: TPUJob, objs):
+        """Adopt matching orphans; skip objects owned by someone else
+        (reference controller_ref_manager.go:169-223)."""
+        claimed = []
+        for obj in objs:
+            ref = obj.metadata.controller_ref()
+            if ref is None:
+                if job.metadata.deletion_timestamp is not None:
+                    continue
+                obj.metadata.owner_references.append(controller_owner_ref(job))
+                try:
+                    obj = self.store.update(kind, obj)
+                except (store_mod.ConflictError, store_mod.NotFoundError):
+                    continue
+                claimed.append(obj)
+            elif ref.uid == job.metadata.uid:
+                claimed.append(obj)
+            # else: owned by another controller -> leave it alone
+        return claimed
+
+    def delete_job(self, job: TPUJob) -> None:
+        """Reference DeleteJob (tensorflow/job.go:39-55)."""
+        self.store.try_delete(store_mod.TPUJOBS, job.metadata.namespace,
+                              job.metadata.name)
+        self.expectations.delete_for_job(job.key())
+        self.recorder.event(job, EVENT_TYPE_NORMAL, "SuccessfulDeleteJob",
+                            f"Deleted job: {job.metadata.name}")
+
+    def update_job_status(self, job: TPUJob,
+                          replica_specs: Dict[str, ReplicaSpec]) -> None:
+        pods = self.get_pods_for_job(job)
+        w0 = status_mod.is_worker0_completed(
+            job, replica_specs, pods, self.get_default_container_name())
+        status_mod.update_job_status(job, replica_specs, w0,
+                                     recorder=self.recorder,
+                                     workqueue=self.workqueue)
+
+    def update_job_status_in_api(self, job: TPUJob) -> None:
+        try:
+            self.store.update_status(store_mod.TPUJOBS, job)
+        except store_mod.NotFoundError:
+            pass  # job deleted mid-sync
+
+    def set_cluster_spec(self, job: TPUJob, pod: Pod, rtype: str,
+                         index: int) -> None:
+        container = pod.spec.container(self.get_default_container_name())
+        if container is None:
+            return
+        env = render_worker_env(job, rtype, index)
+        # User-provided env wins over injected env? No: bootstrap identity
+        # env must be authoritative (reference overwrites TF_CONFIG).
+        container.env.update(env)

@@ -1,0 +1,52 @@
+"""Operator assembly: store + controller + data-plane backend.
+
+Reference parity: cmd/tf-operator.v1/app/server.go Run() — builds
+clients, informers, the controller, and runs it (leader election and the
+monitoring endpoint attach here; see cli.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from tf_operator_tpu.controller.engine import EngineConfig
+from tf_operator_tpu.controller.gang import SliceGangScheduler
+from tf_operator_tpu.controller.tpu_controller import TPUJobController
+from tf_operator_tpu.runtime.events import Recorder
+from tf_operator_tpu.runtime.local import LocalProcessBackend
+from tf_operator_tpu.runtime.store import Store
+
+log = logging.getLogger("tpu_operator.operator")
+
+
+class Operator:
+    def __init__(self, store: Optional[Store] = None,
+                 backend: Optional[LocalProcessBackend] = None,
+                 config: Optional[EngineConfig] = None,
+                 namespace: Optional[str] = None,
+                 enable_gang_scheduling: bool = False,
+                 total_chips: Optional[int] = None):
+        self.store = store or Store()
+        self.recorder = Recorder()
+        config = config or EngineConfig()
+        gang = None
+        if enable_gang_scheduling:
+            config.enable_gang_scheduling = True
+            gang = SliceGangScheduler(self.store, total_chips=total_chips)
+        self.controller = TPUJobController(self.store, recorder=self.recorder,
+                                           config=config, gang=gang,
+                                           namespace=namespace)
+        self.backend = backend if backend is not None else LocalProcessBackend(self.store)
+
+    def start(self, threadiness: int = 2) -> None:
+        if self.backend is not None:
+            self.backend.start()
+        self.controller.run(threadiness=threadiness)
+        log.info("operator started (threadiness=%d)", threadiness)
+
+    def stop(self) -> None:
+        self.controller.stop()
+        if self.backend is not None:
+            self.backend.stop()
+        self.store.stop_watchers()

@@ -1,0 +1,299 @@
+"""Local process backend — the kubelet/data-plane analog.
+
+The reference hands pods to kubelet and watches status flow back through
+the API server (SURVEY §3.3). This backend does the same hermetically:
+it watches the store for pods, runs each container as a subprocess, and
+writes phase transitions (Pending -> Running -> Succeeded/Failed with
+exit codes) back to the store, driving the controller's watch feedback
+loop. Pod-level restartPolicy (Always/OnFailure) is honored in-place with
+restart counts, which feeds the engine's PastBackoffLimit policy.
+
+Single-host service discovery: env rendered by the bootstrap layer uses
+cluster DNS names; ``_localize_env`` rewrites them to 127.0.0.1 with a
+per-job coordinator port so real multi-process jax.distributed jobs can
+rendezvous locally. Cluster backends (GKE) would resolve the same names
+via per-replica headless services instead.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import socket
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.api.types import (
+    ContainerStatus,
+    Pod,
+    PodPhase,
+    PodStatus,
+    RestartPolicy,
+)
+from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.store import ADDED, DELETED, MODIFIED, Store
+
+log = logging.getLogger("tpu_operator.local_backend")
+
+_GRACE_SECONDS = 3.0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class _RunningPod:
+    pod: Pod
+    processes: Dict[str, subprocess.Popen] = field(default_factory=dict)
+    restart_counts: Dict[str, int] = field(default_factory=dict)
+    stop_requested: bool = False
+    done: bool = False
+
+
+class LocalProcessBackend:
+    def __init__(self, store: Store, workdir: Optional[str] = None,
+                 extra_env: Optional[Dict[str, str]] = None):
+        self.store = store
+        self.workdir = workdir or os.getcwd()
+        self.extra_env = dict(extra_env or {})
+        self._lock = threading.Lock()
+        self._running: Dict[str, _RunningPod] = {}  # "ns/name" -> state
+        self._job_ports: Dict[str, int] = {}        # job uid -> coord port
+        self._watcher = None
+        self._stopped = False
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._watcher = self.store.watch(store_mod.PODS, self._on_pod_event)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._watcher:
+            self._watcher.stop()
+        with self._lock:
+            running = list(self._running.values())
+        for rp in running:
+            self._terminate(rp)
+
+    def _on_pod_event(self, event_type: str, pod: Pod) -> None:
+        if self._stopped:
+            return
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        if event_type == ADDED:
+            with self._lock:
+                if key in self._running:
+                    return
+                rp = _RunningPod(pod=pod)
+                self._running[key] = rp
+            threading.Thread(target=self._run_pod, args=(key, rp),
+                             daemon=True).start()
+        elif event_type == DELETED:
+            with self._lock:
+                rp = self._running.pop(key, None)
+            if rp is not None:
+                # Termination can block for the grace period; keep the watch
+                # dispatcher thread free.
+                threading.Thread(target=self._terminate, args=(rp,),
+                                 daemon=True).start()
+
+    # ------------------------------------------------------------------
+
+    def _run_pod(self, key: str, rp: _RunningPod) -> None:
+        pod = rp.pod
+        if not self._await_gang_admission(rp):
+            return  # pod deleted while gated
+        try:
+            self._spawn_all(rp)
+        except Exception as e:  # bad command etc. -> Failed
+            log.warning("pod %s failed to start: %s", key, e)
+            self._write_status(pod, PodPhase.FAILED, message=str(e))
+            return
+        self._write_running(rp)
+        self._wait_pod(key, rp)
+
+    def _await_gang_admission(self, rp: _RunningPod) -> bool:
+        """Gang-scheduled pods stay Pending until their SliceGroup is
+        admitted (Volcano's gating behavior). Gated on the gang annotation,
+        which is stamped on every pod of a gang-scheduled job regardless of
+        any custom scheduler name in the template."""
+        from tf_operator_tpu.api import constants
+        from tf_operator_tpu.controller.gang import PHASE_INQUEUE, PHASE_RUNNING
+
+        pod = rp.pod
+        group_name = pod.metadata.annotations.get(
+            constants.ANNOTATION_GANG_GROUP, "")
+        if not group_name:
+            return True
+        while not (rp.stop_requested or self._stopped):
+            group = self.store.try_get(store_mod.SLICEGROUPS,
+                                       pod.metadata.namespace, group_name)
+            if group is not None and group.status.phase in (PHASE_INQUEUE,
+                                                            PHASE_RUNNING):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def _spawn_all(self, rp: _RunningPod) -> None:
+        for container in rp.pod.spec.containers:
+            self._spawn(rp, container.name)
+
+    def _spawn(self, rp: _RunningPod, container_name: str) -> None:
+        pod = rp.pod
+        container = pod.spec.container(container_name)
+        argv = list(container.command) + list(container.args)
+        if not argv:
+            raise ValueError(f"container {container_name} has no command")
+        env = dict(self.extra_env)
+        env.setdefault("PATH", os.environ.get("PATH", "/usr/bin:/bin"))
+        for var in ("PYTHONPATH", "HOME", "LANG"):
+            if var in os.environ:
+                env.setdefault(var, os.environ[var])
+        env.update(self._localize_env(pod, container.env))
+        env["TPUJOB_POD_NAME"] = pod.metadata.name
+        env["TPUJOB_POD_NAMESPACE"] = pod.metadata.namespace
+        proc = subprocess.Popen(
+            argv,
+            cwd=container.working_dir or self.workdir,
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        rp.processes[container_name] = proc
+
+    def _localize_env(self, pod: Pod, env: Dict[str, str]) -> Dict[str, str]:
+        """Rewrite cluster DNS names to 127.0.0.1 for single-host runs."""
+        job_uid = ""
+        ref = pod.metadata.controller_ref()
+        if ref is not None:
+            job_uid = ref.uid
+        with self._lock:
+            port = self._job_ports.get(job_uid)
+            if port is None:
+                port = _free_port()
+                self._job_ports[job_uid] = port
+        out = {}
+        for k, v in env.items():
+            if k in ("JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS"):
+                out[k] = f"127.0.0.1:{port}"
+            elif k == "TPU_WORKER_HOSTNAMES":
+                out[k] = ",".join("127.0.0.1" for _ in v.split(","))
+            else:
+                out[k] = v
+        return out
+
+    # ------------------------------------------------------------------
+
+    def _wait_pod(self, key: str, rp: _RunningPod) -> None:
+        """Monitor processes; honor pod restartPolicy; write final phase."""
+        pod = rp.pod
+        policy = pod.spec.restart_policy or RestartPolicy.NEVER
+        while True:
+            if rp.stop_requested:
+                return
+            exited = {}
+            for name, proc in list(rp.processes.items()):
+                code = proc.poll()
+                if code is not None:
+                    exited[name] = code
+            if len(exited) == len(rp.processes):
+                # all containers done; decide restart vs terminal
+                should_restart = (
+                    policy == RestartPolicy.ALWAYS
+                    or (policy == RestartPolicy.ON_FAILURE
+                        and any(c != 0 for c in exited.values())))
+                if should_restart and not rp.stop_requested:
+                    for name in exited:
+                        rp.restart_counts[name] = rp.restart_counts.get(name, 0) + 1
+                    try:
+                        self._spawn_all(rp)
+                    except Exception as e:
+                        self._write_status(pod, PodPhase.FAILED, message=str(e))
+                        return
+                    self._write_running(rp)
+                    continue
+                rp.done = True
+                phase = (PodPhase.SUCCEEDED
+                         if all(c == 0 for c in exited.values())
+                         else PodPhase.FAILED)
+                self._write_status(pod, phase, exit_codes=exited, rp=rp)
+                return
+            time.sleep(0.02)
+
+    def _terminate(self, rp: _RunningPod) -> None:
+        rp.stop_requested = True
+        procs = list(rp.processes.values())
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.monotonic() + _GRACE_SECONDS
+        for proc in procs:
+            remaining = deadline - time.monotonic()
+            try:
+                proc.wait(timeout=max(0.05, remaining))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                proc.wait(timeout=5)
+
+    # ------------------------------------------------------------------
+
+    def _write_running(self, rp: _RunningPod) -> None:
+        pod = rp.pod
+        status = PodStatus(
+            phase=PodPhase.RUNNING,
+            start_time=rp.pod.status.start_time or _now(),
+            host="127.0.0.1",
+            container_statuses=[
+                ContainerStatus(name=name, state="Running",
+                                restart_count=rp.restart_counts.get(name, 0))
+                for name in rp.processes
+            ],
+        )
+        rp.pod.status = status
+        self._write_pod_status(pod, status)
+
+    def _write_status(self, pod: Pod, phase: str,
+                      exit_codes: Optional[Dict[str, int]] = None,
+                      message: str = "",
+                      rp: Optional[_RunningPod] = None) -> None:
+        statuses = []
+        for name, code in (exit_codes or {}).items():
+            statuses.append(ContainerStatus(
+                name=name, state="Terminated", exit_code=code,
+                restart_count=(rp.restart_counts.get(name, 0) if rp else 0)))
+        status = PodStatus(phase=phase, message=message,
+                           start_time=pod.status.start_time or _now(),
+                           host="127.0.0.1",
+                           container_statuses=statuses)
+        self._write_pod_status(pod, status)
+
+    def _write_pod_status(self, pod: Pod, status: PodStatus) -> None:
+        stored = self.store.try_get(store_mod.PODS, pod.metadata.namespace,
+                                    pod.metadata.name)
+        if stored is None:
+            return  # deleted concurrently
+        stored.status = status
+        try:
+            self.store.update_status(store_mod.PODS, stored)
+        except store_mod.NotFoundError:
+            pass
+
+
+def _now():
+    import datetime as _dt
+
+    return _dt.datetime.now(_dt.timezone.utc)

@@ -1,0 +1,211 @@
+"""In-memory object store with watch — the API-server/etcd analog.
+
+The reference talks to the Kubernetes API server through clientsets and
+shared informers (pkg/client/, cmd/tf-operator.v1/app/server.go:129-144).
+This store provides the same contract process-natively so the whole
+control loop runs hermetically:
+
+- CRUD with uid assignment, resourceVersion bumps and optimistic
+  concurrency on update;
+- label-selector list;
+- watch: registered handlers receive (ADDED/MODIFIED/DELETED, object)
+  callbacks on a dispatcher thread per watcher (informer analog — objects
+  are deep-copied both ways, preserving the informer-cache immutability
+  discipline the reference relies on, controller.go:325).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import itertools
+import queue
+import threading
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+class ConflictError(Exception):
+    pass
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class AlreadyExistsError(Exception):
+    pass
+
+
+def _matches(labels: Dict[str, str], selector: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class Watcher:
+    def __init__(self, kind: str, handler: Callable[[str, object], None]):
+        self.kind = kind
+        self.handler = handler
+        self.queue: "queue.Queue[Optional[Tuple[str, object]]]" = queue.Queue()
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self.queue.get()
+            if item is None:
+                return
+            event_type, obj = item
+            try:
+                self.handler(event_type, obj)
+            except Exception:  # watch handlers must never kill the dispatcher
+                import logging
+
+                logging.getLogger("tpu_operator.store").exception(
+                    "watch handler error for %s", self.kind)
+
+    def stop(self) -> None:
+        self.queue.put(None)
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+        # kind -> {(namespace, name) -> obj}
+        self._objects: Dict[str, Dict[Tuple[str, str], object]] = {}
+        self._watchers: List[Watcher] = []
+        self._rv = itertools.count(1)
+
+    # -- CRUD -------------------------------------------------------------
+
+    def create(self, kind: str, obj) -> object:
+        with self._lock:
+            coll = self._objects.setdefault(kind, {})
+            key = (obj.metadata.namespace, obj.metadata.name)
+            if key in coll:
+                raise AlreadyExistsError(f"{kind} {key} already exists")
+            obj = obj.deepcopy()
+            if not obj.metadata.uid:
+                obj.metadata.uid = str(uuid.uuid4())
+            if obj.metadata.creation_timestamp is None:
+                obj.metadata.creation_timestamp = _dt.datetime.now(
+                    _dt.timezone.utc)
+            obj.metadata.resource_version = next(self._rv)
+            coll[key] = obj
+            self._notify(kind, ADDED, obj)
+            return obj.deepcopy()
+
+    def get(self, kind: str, namespace: str, name: str) -> object:
+        with self._lock:
+            try:
+                return self._objects[kind][(namespace, name)].deepcopy()
+            except KeyError:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+
+    def try_get(self, kind: str, namespace: str, name: str):
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             selector: Optional[Dict[str, str]] = None) -> List[object]:
+        with self._lock:
+            out = []
+            for (ns, _), obj in self._objects.get(kind, {}).items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if selector and not _matches(obj.metadata.labels, selector):
+                    continue
+                out.append(obj.deepcopy())
+            return out
+
+    def update(self, kind: str, obj) -> object:
+        """Full-object update with optimistic concurrency: the caller's
+        resourceVersion must match the stored one."""
+        with self._lock:
+            coll = self._objects.setdefault(kind, {})
+            key = (obj.metadata.namespace, obj.metadata.name)
+            current = coll.get(key)
+            if current is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            if (obj.metadata.resource_version
+                    and obj.metadata.resource_version
+                    != current.metadata.resource_version):
+                raise ConflictError(
+                    f"{kind} {key}: resourceVersion "
+                    f"{obj.metadata.resource_version} != "
+                    f"{current.metadata.resource_version}")
+            obj = obj.deepcopy()
+            obj.metadata.uid = current.metadata.uid
+            obj.metadata.creation_timestamp = current.metadata.creation_timestamp
+            obj.metadata.resource_version = next(self._rv)
+            coll[key] = obj
+            self._notify(kind, MODIFIED, obj)
+            return obj.deepcopy()
+
+    def update_status(self, kind: str, obj) -> object:
+        """Status-subresource-style update: merges only .status (and
+        completion metadata) into the stored object, avoiding spec clobber."""
+        with self._lock:
+            coll = self._objects.setdefault(kind, {})
+            key = (obj.metadata.namespace, obj.metadata.name)
+            current = coll.get(key)
+            if current is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            stored = current.deepcopy()
+            stored.status = obj.status.deepcopy()
+            stored.metadata.resource_version = next(self._rv)
+            coll[key] = stored
+            self._notify(kind, MODIFIED, stored)
+            return stored.deepcopy()
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            coll = self._objects.get(kind, {})
+            obj = coll.pop((namespace, name), None)
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            self._notify(kind, DELETED, obj)
+
+    def try_delete(self, kind: str, namespace: str, name: str) -> bool:
+        try:
+            self.delete(kind, namespace, name)
+            return True
+        except NotFoundError:
+            return False
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(self, kind: str,
+              handler: Callable[[str, object], None],
+              replay: bool = True) -> Watcher:
+        """Register a handler; with ``replay`` existing objects are
+        delivered as ADDED first (informer initial list)."""
+        with self._lock:
+            w = Watcher(kind, handler)
+            if replay:
+                for obj in self._objects.get(kind, {}).values():
+                    w.queue.put((ADDED, obj.deepcopy()))
+            self._watchers.append(w)
+            return w
+
+    def stop_watchers(self) -> None:
+        with self._lock:
+            watchers, self._watchers = self._watchers, []
+        for w in watchers:
+            w.stop()
+
+    def _notify(self, kind: str, event_type: str, obj) -> None:
+        for w in self._watchers:
+            if w.kind == kind:
+                w.queue.put((event_type, obj.deepcopy()))
+
+
+# Canonical collection names.
+TPUJOBS = "tpujobs"
+PODS = "pods"
+ENDPOINTS = "endpoints"
+SLICEGROUPS = "slicegroups"

@@ -1,0 +1,85 @@
+"""Controllable worker stub — the e2e fault-injection payload.
+
+Reference analog: test/test-server/test_app.py, the Flask app run *as*
+the TF replicas in e2e so the harness can read each replica's TF_CONFIG
+(`/tfconfig`) and make any replica exit with any code (`/exit`). This
+stub is file-based instead of HTTP (deterministic, dependency-free):
+
+- at startup it writes its identity + bootstrap env snapshot to
+  ``$TPUJOB_STUB_DIR/{pod}.env.json``;
+- it polls ``$TPUJOB_STUB_DIR/{pod}.cmd`` for a line ``exit:N`` and exits
+  with code N when told;
+- ``--exit-after S --exit-code N`` terminates autonomously.
+
+Run as: ``python -m tf_operator_tpu.runtime.worker_stub [flags]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ENV_KEYS = (
+    "TPUJOB_CLUSTER_SPEC",
+    "TPU_WORKER_ID",
+    "TPU_WORKER_HOSTNAMES",
+    "TPU_ACCELERATOR_TYPE",
+    "TPU_TOPOLOGY",
+    "JAX_COORDINATOR_ADDRESS",
+    "JAX_NUM_PROCESSES",
+    "JAX_PROCESS_ID",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "MEGASCALE_NUM_SLICES",
+    "MEGASCALE_SLICE_ID",
+    "TPUJOB_POD_NAME",
+    "TPUJOB_POD_NAMESPACE",
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--exit-after", type=float, default=None,
+                        help="exit autonomously after this many seconds")
+    parser.add_argument("--exit-code", type=int, default=0)
+    parser.add_argument("--poll-interval", type=float, default=0.05)
+    args = parser.parse_args(argv)
+
+    stub_dir = os.environ.get("TPUJOB_STUB_DIR", "")
+    pod_name = os.environ.get("TPUJOB_POD_NAME", f"pid-{os.getpid()}")
+
+    cmd_path = None
+    if stub_dir:
+        os.makedirs(stub_dir, exist_ok=True)
+        snapshot = {k: os.environ[k] for k in ENV_KEYS if k in os.environ}
+        snapshot["argv"] = sys.argv[1:]
+        with open(os.path.join(stub_dir, f"{pod_name}.env.json"), "w") as f:
+            json.dump(snapshot, f, indent=2, sort_keys=True)
+        cmd_path = os.path.join(stub_dir, f"{pod_name}.cmd")
+
+    deadline = (time.monotonic() + args.exit_after
+                if args.exit_after is not None else None)
+    while True:
+        if cmd_path and os.path.exists(cmd_path):
+            with open(cmd_path) as f:
+                line = f.read().strip()
+            # Parse before unlinking: a partially-written file (non-atomic
+            # writer) is left in place for the next poll.
+            code = None
+            if line.startswith("exit:"):
+                try:
+                    code = int(line.split(":", 1)[1])
+                except ValueError:
+                    code = None
+            if code is not None:
+                os.unlink(cmd_path)
+                return code
+        if deadline is not None and time.monotonic() >= deadline:
+            return args.exit_code
+        time.sleep(args.poll_interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

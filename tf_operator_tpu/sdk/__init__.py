@@ -1,0 +1,3 @@
+"""Python SDK (reference: sdk/python/kubeflow/tfjob TFJobClient)."""
+
+from tf_operator_tpu.sdk.client import TPUJobClient  # noqa: F401

@@ -1,0 +1,144 @@
+"""TPUJobClient: the user-facing job API.
+
+Reference parity: sdk/python/kubeflow/tfjob/api/tf_job_client.py:55-446 —
+create/get/patch/delete, wait_for_job/wait_for_condition, status
+helpers (is_job_running/succeeded), get_pod_names/get_logs. The client
+talks to a Store (in-process or served); conditions/statuses have the
+same shape as the reference SDK's V1JobStatus.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Union
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import JobConditionType, Pod, TPUJob
+from tf_operator_tpu.controller import conditions as cond
+from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.store import Store
+
+
+class TimeoutError_(TimeoutError):
+    pass
+
+
+class TPUJobClient:
+    def __init__(self, store: Store, namespace: str = "default"):
+        self.store = store
+        self.namespace = namespace
+
+    # -- CRUD (reference tf_job_client.py:77-222) -----------------------
+
+    def create(self, job: Union[TPUJob, dict],
+               namespace: Optional[str] = None) -> TPUJob:
+        if isinstance(job, dict):
+            job = TPUJob.from_dict(job)
+        if namespace:
+            job.metadata.namespace = namespace
+        elif not job.metadata.namespace:
+            job.metadata.namespace = self.namespace
+        return self.store.create(store_mod.TPUJOBS, job)
+
+    def get(self, name: str, namespace: Optional[str] = None) -> TPUJob:
+        return self.store.get(store_mod.TPUJOBS,
+                              namespace or self.namespace, name)
+
+    def patch(self, name: str, patch_fn: Callable[[TPUJob], None],
+              namespace: Optional[str] = None) -> TPUJob:
+        """Optimistic-concurrency read-modify-write (the SDK's patch)."""
+        ns = namespace or self.namespace
+        for _ in range(10):
+            job = self.store.get(store_mod.TPUJOBS, ns, name)
+            patch_fn(job)
+            try:
+                return self.store.update(store_mod.TPUJOBS, job)
+            except store_mod.ConflictError:
+                continue
+        raise store_mod.ConflictError(f"patch of {ns}/{name} kept conflicting")
+
+    def delete(self, name: str, namespace: Optional[str] = None) -> None:
+        self.store.delete(store_mod.TPUJOBS, namespace or self.namespace, name)
+
+    def list(self, namespace: Optional[str] = None) -> List[TPUJob]:
+        return self.store.list(store_mod.TPUJOBS,
+                               namespace=namespace or self.namespace)
+
+    # -- waiting (reference tf_job_client.py:223-305) -------------------
+
+    def wait_for_condition(self, name: str, expected_condition: str,
+                           timeout: float = 60.0,
+                           namespace: Optional[str] = None,
+                           poll_interval: float = 0.05) -> TPUJob:
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            last = self.get(name, namespace)
+            if cond.has_condition(last.status, expected_condition):
+                return last
+            time.sleep(poll_interval)
+        conds = [(c.type, c.status) for c in last.status.conditions] if last else []
+        raise TimeoutError_(
+            f"timed out waiting for {expected_condition} on {name}; "
+            f"conditions={conds}")
+
+    def wait_for_job(self, name: str, timeout: float = 60.0,
+                     namespace: Optional[str] = None) -> TPUJob:
+        """Wait until Succeeded or Failed (reference wait_for_job)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            job = self.get(name, namespace)
+            if cond.is_finished(job.status):
+                return job
+            time.sleep(0.05)
+        raise TimeoutError_(f"timed out waiting for {name} to finish")
+
+    def wait_for_delete(self, name: str, timeout: float = 60.0,
+                        namespace: Optional[str] = None) -> None:
+        deadline = time.monotonic() + timeout
+        ns = namespace or self.namespace
+        while time.monotonic() < deadline:
+            if self.store.try_get(store_mod.TPUJOBS, ns, name) is None:
+                return
+            time.sleep(0.05)
+        raise TimeoutError_(f"timed out waiting for {name} to be deleted")
+
+    # -- status helpers (reference tf_job_client.py:306-342) ------------
+
+    def get_job_status(self, name: str,
+                       namespace: Optional[str] = None) -> str:
+        job = self.get(name, namespace)
+        if job.status.conditions:
+            return job.status.conditions[-1].type
+        return ""
+
+    def is_job_running(self, name: str, namespace: Optional[str] = None) -> bool:
+        return cond.is_running(self.get(name, namespace).status)
+
+    def is_job_succeeded(self, name: str,
+                         namespace: Optional[str] = None) -> bool:
+        return cond.is_succeeded(self.get(name, namespace).status)
+
+    # -- pods (reference tf_job_client.py:343-446) ----------------------
+
+    def get_pod_names(self, name: str, namespace: Optional[str] = None,
+                      replica_type: Optional[str] = None,
+                      replica_index: Optional[int] = None) -> List[str]:
+        selector: Dict[str, str] = {
+            constants.LABEL_GROUP_NAME: constants.GROUP,
+            constants.LABEL_JOB_NAME: name,
+        }
+        if replica_type is not None:
+            selector[constants.LABEL_REPLICA_TYPE] = replica_type.lower()
+        if replica_index is not None:
+            selector[constants.LABEL_REPLICA_INDEX] = str(replica_index)
+        pods = self.store.list(store_mod.PODS,
+                               namespace=namespace or self.namespace,
+                               selector=selector)
+        return sorted(p.metadata.name for p in pods)
+
+    def get_pods(self, name: str, namespace: Optional[str] = None) -> List[Pod]:
+        return self.store.list(
+            store_mod.PODS, namespace=namespace or self.namespace,
+            selector={constants.LABEL_GROUP_NAME: constants.GROUP,
+                      constants.LABEL_JOB_NAME: name})
