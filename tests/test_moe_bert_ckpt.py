@@ -1,0 +1,130 @@
+"""Mixtral (EP), BERT (MLM), and checkpoint/resume tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tf_operator_tpu.models import bert as bert_mod
+from tf_operator_tpu.models.mixtral import (
+    Mixtral,
+    make_moe_lm_loss,
+    mixtral_tiny,
+    param_logical_axes as moe_axes,
+)
+from tf_operator_tpu.models.llama import Llama, llama_tiny, param_logical_axes
+from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh
+from tf_operator_tpu.parallel.sharding import LLAMA_RULES, MOE_RULES
+from tf_operator_tpu.train.trainer import Trainer
+
+
+def tokens_batch(rng_seed, batch, seq, vocab):
+    return {"inputs": jnp.asarray(np.random.default_rng(rng_seed).integers(
+        0, vocab, (batch, seq)), jnp.int32)}
+
+
+def test_mixtral_learns_with_expert_parallelism():
+    mesh = make_mesh(MeshConfig(dp=2, ep=4))
+    cfg = mixtral_tiny()
+    tr = Trainer(model=Mixtral(cfg), param_axes_fn=moe_axes, rules=MOE_RULES,
+                 mesh=mesh, optimizer=optax.adam(1e-2),
+                 loss_fn=make_moe_lm_loss(cfg.aux_loss_weight),
+                 model_inputs_fn=lambda b: (b["inputs"][:, :-1],))
+    rng = jax.random.PRNGKey(0)
+    sample = {"inputs": jnp.zeros((8, 33), jnp.int32)}
+    state, sh = tr.init(rng, sample)
+    # experts sharded over ep
+    spec = state.params["blocks"]["moe"]["w_gate"].sharding.spec
+    assert "ep" in jax.tree.leaves(tuple(spec))
+    step = tr.make_train_step(sh, sample)
+    tok = tokens_batch(0, 8, 33, cfg.vocab_size)
+    losses = []
+    for _ in range(8):
+        state, m = step(state, tok)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 1.0
+
+
+def test_moe_routing_capacity_drops_are_bounded():
+    # With capacity_factor 1.25 and uniform-ish routing at init, most
+    # tokens must be dispatched (sanity check on the dispatch tensors).
+    cfg = mixtral_tiny()
+    model = Mixtral(cfg)
+    rng = jax.random.PRNGKey(0)
+    tok = tokens_batch(1, 4, 32, cfg.vocab_size)["inputs"]
+    params = model.init(rng, tok)
+    logits, aux = model.apply(params, tok)
+    assert logits.shape == (4, 32, cfg.vocab_size)
+    assert np.isfinite(float(aux))
+    # aux ~ 1.0 means balanced; blowups indicate collapsed routing
+    assert 0.5 < float(aux) < 4.0
+
+
+def test_bert_mlm_learns():
+    mesh = make_mesh(MeshConfig(dp=2, tp=4))
+    cfg = bert_mod.bert_tiny()
+    tr = Trainer(model=bert_mod.Bert(cfg),
+                 param_axes_fn=bert_mod.param_logical_axes,
+                 rules=LLAMA_RULES, mesh=mesh, optimizer=optax.adam(1e-2),
+                 loss_fn=bert_mod.mlm_loss,
+                 model_inputs_fn=lambda b: (b["inputs"],))
+    rng = jax.random.PRNGKey(0)
+    rnd = np.random.default_rng(0)
+    b, s = 8, 32
+    targets = rnd.integers(0, cfg.vocab_size, (b, s))
+    mask = rnd.random((b, s)) < 0.15
+    inputs = np.where(mask, 0, targets)  # 0 = [MASK]
+    batch = {"inputs": jnp.asarray(inputs, jnp.int32),
+             "targets": jnp.asarray(targets, jnp.int32),
+             "mask": jnp.asarray(mask)}
+    state, sh = tr.init(rng, batch)
+    step = tr.make_train_step(sh, batch)
+    losses = []
+    for _ in range(10):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.5
+
+
+def test_checkpoint_save_restore_resume(tmp_path):
+    from tf_operator_tpu.train.checkpoint import (
+        Checkpointer,
+        abstract_state_with_shardings,
+    )
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    cfg = llama_tiny()
+    tr = Trainer(model=Llama(cfg), param_axes_fn=param_logical_axes,
+                 rules=LLAMA_RULES, mesh=mesh, optimizer=optax.adam(1e-2))
+    rng = jax.random.PRNGKey(0)
+    sample = {"inputs": jnp.zeros((8, 33), jnp.int32)}
+    state, sh = tr.init(rng, sample)
+    step = tr.make_train_step(sh, sample)
+    tok = tokens_batch(2, 8, 33, cfg.vocab_size)
+    for _ in range(3):
+        state, m = step(state, tok)
+    loss3 = float(m["loss"])
+
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+    assert ckpt.save(int(state.step), state)
+    ckpt.wait()
+    assert ckpt.latest_step() == 3
+
+    # fresh trainer restores and continues identically
+    tr2 = Trainer(model=Llama(cfg), param_axes_fn=param_logical_axes,
+                  rules=LLAMA_RULES, mesh=mesh, optimizer=optax.adam(1e-2))
+    _, sh2 = tr2.init(rng, sample)
+    abstract = abstract_state_with_shardings(
+        tr2._init_fn, sh2, rng, sample)
+    restored = ckpt.restore(abstract)
+    assert int(restored.step) == 3
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(restored.params["final_norm"]["scale"])),
+        np.asarray(jax.device_get(state.params["final_norm"]["scale"])))
+
+    step2 = tr2.make_train_step(sh2, sample)
+    state_a, ma = step(state, tok)
+    state_b, mb = step2(restored, tok)
+    assert abs(float(ma["loss"]) - float(mb["loss"])) < 1e-5
+    ckpt.close()

@@ -1,0 +1,2 @@
+"""Model families for the BASELINE configs: MNIST, ResNet-50, BERT,
+Llama (dense decoder), Mixtral (MoE decoder)."""

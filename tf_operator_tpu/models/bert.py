@@ -1,0 +1,168 @@
+"""BERT-family encoder (BASELINE config: "BERT-base pretraining TFJob,
+PS + 8 Workers with gang scheduling").
+
+Bidirectional transformer encoder with an MLM head, same scan-over-layers
+TPU structure as the decoder families. MLM batches carry
+``inputs``/``targets``/``mask`` (masked positions only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.ops.layers import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    head_dim: int = 64
+    mlp_dim: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+
+def bert_base() -> BertConfig:
+    return BertConfig()
+
+
+def bert_tiny(vocab_size: int = 256, max_seq_len: int = 128) -> BertConfig:
+    return BertConfig(vocab_size=vocab_size, hidden=64, n_layers=2,
+                      n_heads=4, head_dim=16, mlp_dim=128,
+                      max_seq_len=max_seq_len, remat=False)
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array,
+                 attn_mask: Optional[jax.Array]) -> jax.Array:
+        cfg = self.config
+        dense = lambda feats, name: nn.Dense(
+            feats, dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+        b, s, _ = x.shape
+        q = dense(cfg.n_heads * cfg.head_dim, "wq")(x)
+        k = dense(cfg.n_heads * cfg.head_dim, "wk")(x)
+        v = dense(cfg.n_heads * cfg.head_dim, "wv")(x)
+        q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        mask = None
+        if attn_mask is not None:
+            mask = attn_mask[:, None, None, :].astype(bool)  # [B,1,1,S]
+        out = attention(q, k, v, causal=False, mask=mask)
+        out = out.reshape(b, s, cfg.n_heads * cfg.head_dim)
+        return dense(cfg.hidden, "wo")(out)
+
+
+class BertBlock(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, attn_mask: Optional[jax.Array]
+                 ) -> Tuple[jax.Array, None]:
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(dtype=cfg.dtype,
+                                       param_dtype=jnp.float32, name=name)
+        x = ln("attn_ln")(x + BertSelfAttention(cfg, name="attn")(x, attn_mask))
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlp_in")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlp_out")(h)
+        x = ln("mlp_ln")(x + h)
+        return x, None
+
+
+class Bert(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array,
+                 attn_mask: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.config
+        b, s = tokens.shape
+        x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed_tokens")(tokens)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (cfg.max_seq_len, cfg.hidden), jnp.float32)
+        x = x + pos[None, :s].astype(cfg.dtype)
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="embed_ln")(x)
+
+        block = BertBlock
+        if cfg.remat:
+            block = nn.remat(block, prevent_cse=False)
+        ScanBlocks = nn.scan(
+            block,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=nn.broadcast,
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        x, _ = ScanBlocks(cfg, name="blocks")(x, attn_mask)
+
+        # MLM head: transform + tied-free output projection
+        x = nn.Dense(cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlm_transform")(x)
+        x = nn.gelu(x, approximate=True)
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="mlm_ln")(x)
+        return nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name="mlm_head")(x)
+
+
+_LEAF_AXES = {
+    ("embed_tokens", "embedding"): ("vocab", "embed"),
+    ("pos_embed",): ("seq", "embed"),
+    ("wq", "kernel"): ("embed", "heads"),
+    ("wk", "kernel"): ("embed", "heads"),
+    ("wv", "kernel"): ("embed", "heads"),
+    ("wo", "kernel"): ("heads", "embed"),
+    ("mlp_in", "kernel"): ("embed", "mlp"),
+    ("mlp_out", "kernel"): ("mlp", "embed"),
+    # both dims are embed-sized; shard only one (an axis may appear once)
+    ("mlm_transform", "kernel"): ("embed", None),
+    ("mlm_head", "kernel"): ("embed", "vocab"),
+}
+
+
+def param_logical_axes(path: Tuple[str, ...], value):
+    path = tuple(path)
+    ndim = value.ndim if hasattr(value, "ndim") else len(value.shape)
+    for suffix, axes in _LEAF_AXES.items():
+        if path[-len(suffix):] == suffix:
+            if len(axes) == ndim:
+                return axes
+            if len(axes) + 1 == ndim and "blocks" in path:
+                return ("layers",) + axes
+            break
+    # biases, LayerNorm scales: replicate
+    if ndim <= 2:
+        return (None,) * ndim
+    raise ValueError(f"no logical axes for BERT param {'/'.join(path)}")
+
+
+def mlm_loss(params, extra_vars, batch, model_apply):
+    """Masked-LM loss over masked positions only."""
+    from tf_operator_tpu.train.trainer import cross_entropy_loss
+
+    logits = model_apply({"params": params}, batch["inputs"],
+                         batch.get("attn_mask"))
+    return cross_entropy_loss(logits, batch["targets"],
+                              batch.get("mask")), extra_vars
+
+
+mlm_loss.model_inputs_fn = lambda b: (b["inputs"], b.get("attn_mask"))

@@ -1,0 +1,207 @@
+"""Llama-family dense decoder (the BASELINE "Llama-3 8B JAX/SPMD" config).
+
+TPU-first structure:
+- layers are stacked with ``nn.scan`` + ``nn.remat`` — one compiled block
+  body regardless of depth (fast XLA compiles) with rematerialized
+  activations (HBM for FLOPs trade);
+- bfloat16 activations, float32 params/accumulation;
+- attention can run as ring attention over the ``sp`` mesh axis for long
+  context (context parallelism), or plain (to be fused by XLA / pallas);
+- params carry no sharding metadata — logical axes are assigned by
+  ``param_logical_axes`` (path-based), keeping the model mesh-agnostic
+  (rules tables in parallel/sharding.py decide dp/fsdp/tp placement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.ops.layers import (
+    apply_rope,
+    attention,
+    repeat_kv,
+    rms_norm,
+    rope_frequencies,
+)
+from tf_operator_tpu.ops.ring_attention import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    mlp_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # "" = plain attention; "ring" = ring attention over sp (call must be
+    # inside shard_map; the trainer arranges this when sp > 1).
+    attention_impl: str = ""
+    sp_axis: str = "sp"
+
+
+def llama_3_8b() -> LlamaConfig:
+    return LlamaConfig()
+
+
+def llama_tiny(vocab_size: int = 256, max_seq_len: int = 128) -> LlamaConfig:
+    return LlamaConfig(vocab_size=vocab_size, hidden=64, n_layers=2,
+                       n_heads=4, n_kv_heads=2, head_dim=16, mlp_dim=128,
+                       max_seq_len=max_seq_len, rope_theta=10000.0,
+                       remat=False)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, angles: jax.Array) -> jax.Array:
+        cfg = self.config
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        b, s, _ = x.shape
+        q = dense(cfg.n_heads * cfg.head_dim, "wq")(x)
+        k = dense(cfg.n_kv_heads * cfg.head_dim, "wk")(x)
+        v = dense(cfg.n_kv_heads * cfg.head_dim, "wv")(x)
+        q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+
+        # RoPE on the global sequence view (GSPMD handles the sharding;
+        # ring blocks only materialize inside the shard_map region below).
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
+        k = repeat_kv(k, cfg.n_heads // cfg.n_kv_heads)
+        v = repeat_kv(v, cfg.n_heads // cfg.n_kv_heads)
+
+        if cfg.attention_impl == "ring":
+            from tf_operator_tpu.parallel.mesh import active_mesh, data_axes
+            from jax.sharding import PartitionSpec as P
+            import functools
+
+            mesh = active_mesh()
+            if mesh is None:
+                raise ValueError("ring attention requires an active mesh "
+                                 "(wrap the step in parallel.mesh.use_mesh)")
+            spec = P(data_axes(mesh), cfg.sp_axis,
+                     "tp" if "tp" in mesh.axis_names else None, None)
+            out = jax.shard_map(
+                functools.partial(ring_attention, axis_name=cfg.sp_axis,
+                                  causal=True),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False)(q, k, v)
+        else:
+            out = attention(q, k, v, causal=True)
+
+        out = out.reshape(b, s, cfg.n_heads * cfg.head_dim)
+        return dense(cfg.hidden, "wo")(out)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        gate = dense(cfg.mlp_dim, "gate")(x)
+        up = dense(cfg.mlp_dim, "up")(x)
+        return dense(cfg.hidden, "down")(nn.silu(gate) * up)
+
+
+class RMSNorm(nn.Module):
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        return rms_norm(x, scale)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, angles: jax.Array
+                 ) -> Tuple[jax.Array, None]:
+        x = x + LlamaAttention(self.config, name="attn")(
+            RMSNorm(name="attn_norm")(x), angles)
+        x = x + LlamaMLP(self.config, name="mlp")(
+            RMSNorm(name="mlp_norm")(x))
+        return x, None
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed_tokens")(tokens)
+        angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                  cfg.rope_theta)
+
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(block, prevent_cse=False)
+        ScanBlocks = nn.scan(
+            block,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=nn.broadcast,
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        x, _ = ScanBlocks(cfg, name="blocks")(x, angles)
+
+        x = RMSNorm(name="final_norm")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                          param_dtype=jnp.float32, name="lm_head")(x)
+        return logits
+
+
+# ---------------------------------------------------------------------------
+# Logical axes (consumed by parallel/sharding.py rule tables)
+# ---------------------------------------------------------------------------
+
+_LEAF_AXES = {
+    ("embed_tokens", "embedding"): ("vocab", "embed"),
+    ("wq", "kernel"): ("embed", "heads"),
+    ("wk", "kernel"): ("embed", "kv_heads"),
+    ("wv", "kernel"): ("embed", "kv_heads"),
+    ("wo", "kernel"): ("heads", "embed"),
+    ("gate", "kernel"): ("embed", "mlp"),
+    ("up", "kernel"): ("embed", "mlp"),
+    ("down", "kernel"): ("mlp", "embed"),
+    ("lm_head", "kernel"): ("embed", "vocab"),
+    ("scale",): ("norm",),
+}
+
+
+def param_logical_axes(path: Tuple[str, ...], value) -> Tuple[Optional[str], ...]:
+    """Map a param path (flax dict path) to logical axis names; scanned
+    block params get a leading "layers" axis."""
+    path = tuple(path)
+    for suffix, axes in _LEAF_AXES.items():
+        if path[-len(suffix):] == suffix:
+            ndim = value.ndim if hasattr(value, "ndim") else len(value.shape)
+            if len(axes) == ndim:
+                return axes
+            if len(axes) + 1 == ndim and "blocks" in path:
+                return ("layers",) + axes
+            break
+    raise ValueError(f"no logical axes for param {'/'.join(path)} "
+                     f"shape {getattr(value, 'shape', '?')}")

@@ -1,0 +1,224 @@
+"""Mixtral-family MoE decoder (BASELINE config: "Mixtral 8x7B
+expert-parallel multi-slice v5p, DCN all-to-all").
+
+TPU-first MoE: GShard-style dense einsum dispatch — router top-k picks
+experts, tokens are packed into per-expert capacity buffers with one-hot
+dispatch/combine tensors, expert FFNs run as batched einsums over a
+leading expert dim. Expert params shard over the ``ep`` mesh axis
+(MOE_RULES), so XLA lowers the dispatch/combine einsums to all-to-alls
+(ICI within a slice, DCN across slices) — no hand-written comm.
+
+Shares the attention stack with the Llama family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models.llama import (
+    LlamaAttention,
+    LlamaConfig,
+    RMSNorm,
+)
+from tf_operator_tpu.ops.layers import rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    hidden: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    mlp_dim: int = 14336
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.02
+    max_seq_len: int = 8192
+    rope_theta: float = 1000000.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attention_impl: str = ""
+    sp_axis: str = "sp"
+
+    def attention_config(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size, hidden=self.hidden,
+            n_layers=self.n_layers, n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads, head_dim=self.head_dim,
+            mlp_dim=self.mlp_dim, max_seq_len=self.max_seq_len,
+            rope_theta=self.rope_theta, dtype=self.dtype, remat=self.remat,
+            attention_impl=self.attention_impl, sp_axis=self.sp_axis)
+
+
+def mixtral_8x7b() -> MixtralConfig:
+    return MixtralConfig()
+
+
+def mixtral_tiny(vocab_size: int = 256, max_seq_len: int = 128) -> MixtralConfig:
+    return MixtralConfig(vocab_size=vocab_size, hidden=64, n_layers=2,
+                         n_heads=4, n_kv_heads=2, head_dim=16, mlp_dim=128,
+                         n_experts=4, experts_per_token=2,
+                         max_seq_len=max_seq_len, rope_theta=10000.0,
+                         remat=False)
+
+
+class MoELayer(nn.Module):
+    """Token-choice top-k routing with capacity; dense einsum dispatch."""
+
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.config
+        b, s, h = x.shape
+        t = b * s
+        e = cfg.n_experts
+        k = cfg.experts_per_token
+        capacity = max(k, int(t * k * cfg.capacity_factor / e))
+
+        xt = x.reshape(t, h)
+        router_logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
+                                 param_dtype=jnp.float32,
+                                 name="router")(xt.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits, axis=-1)          # [T, E]
+
+        # top-k expert choice per token
+        top_probs, top_idx = jax.lax.top_k(probs, k)             # [T, K]
+        top_probs = top_probs / jnp.maximum(
+            jnp.sum(top_probs, axis=-1, keepdims=True), 1e-9)
+
+        # capacity positions: for each (expert, k) assignment, this token's
+        # slot is the count of earlier tokens choosing the same expert
+        expert_onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.int32)  # [T,K,E]
+        flat_assign = expert_onehot.reshape(t * k, e)
+        position = (jnp.cumsum(flat_assign, axis=0) - flat_assign)    # [T*K,E]
+        position = jnp.sum(position * flat_assign, axis=-1).reshape(t, k)
+        within_capacity = position < capacity                    # [T, K]
+
+        # dispatch [T, E, C] / combine [T, E, C]
+        pos_onehot = jax.nn.one_hot(position, capacity,
+                                    dtype=x.dtype)               # [T,K,C]
+        disp = (expert_onehot.astype(x.dtype)[..., None]
+                * pos_onehot[:, :, None, :]
+                * within_capacity.astype(x.dtype)[:, :, None, None])
+        dispatch = jnp.sum(disp, axis=1)                         # [T,E,C]
+        combine = jnp.sum(disp * top_probs.astype(x.dtype)[:, :, None, None],
+                          axis=1)                                # [T,E,C]
+
+        # expert buffers + batched expert FFNs (leading dim e -> ep axis)
+        expert_in = jnp.einsum("tec,th->ech", dispatch, xt,
+                               preferred_element_type=jnp.float32
+                               ).astype(cfg.dtype)               # [E,C,H]
+        w_gate = self.param("w_gate", nn.initializers.lecun_normal(),
+                            (e, h, cfg.mlp_dim), jnp.float32)
+        w_up = self.param("w_up", nn.initializers.lecun_normal(),
+                          (e, h, cfg.mlp_dim), jnp.float32)
+        w_down = self.param("w_down", nn.initializers.lecun_normal(),
+                            (e, cfg.mlp_dim, h), jnp.float32)
+        gate = jnp.einsum("ech,ehm->ecm", expert_in, w_gate.astype(cfg.dtype))
+        up = jnp.einsum("ech,ehm->ecm", expert_in, w_up.astype(cfg.dtype))
+        act = nn.silu(gate) * up
+        expert_out = jnp.einsum("ecm,emh->ech", act,
+                                w_down.astype(cfg.dtype))        # [E,C,H]
+
+        y = jnp.einsum("tec,ech->th", combine, expert_out)
+        y = y.reshape(b, s, h).astype(x.dtype)
+
+        # load-balancing aux loss (Switch/GShard): E * sum_e f_e * P_e
+        assigned = jnp.sum(dispatch, axis=-1)                    # [T, E]
+        f = jnp.mean(assigned.astype(jnp.float32), axis=0)       # frac routed
+        p = jnp.mean(probs, axis=0)
+        aux = e * jnp.sum(f * p) / k
+        return y, aux
+
+
+class MixtralBlock(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, angles: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.config
+        x = x + LlamaAttention(cfg.attention_config(), name="attn")(
+            RMSNorm(name="attn_norm")(x), angles)
+        moe_out, aux = MoELayer(cfg, name="moe")(RMSNorm(name="mlp_norm")(x))
+        return x + moe_out, aux
+
+
+class Mixtral(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Returns (logits, aux_loss)."""
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed_tokens")(tokens)
+        angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                  cfg.rope_theta)
+
+        block = MixtralBlock
+        if cfg.remat:
+            block = nn.remat(block, prevent_cse=False)
+        ScanBlocks = nn.scan(
+            block,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=nn.broadcast,
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        x, aux = ScanBlocks(cfg, name="blocks")(x, angles)
+
+        x = RMSNorm(name="final_norm")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                          param_dtype=jnp.float32, name="lm_head")(x)
+        return logits, jnp.mean(aux)
+
+
+_MOE_LEAF_AXES = {
+    ("router", "kernel"): ("embed", None),
+    ("w_gate",): ("expert", "embed", "mlp"),
+    ("w_up",): ("expert", "embed", "mlp"),
+    ("w_down",): ("expert", "mlp", "embed"),
+}
+
+
+def param_logical_axes(path: Tuple[str, ...], value):
+    """Mixtral logical axes: MoE params + the shared Llama mapping."""
+    from tf_operator_tpu.models.llama import param_logical_axes as base_axes
+
+    path = tuple(path)
+    for suffix, axes in _MOE_LEAF_AXES.items():
+        if path[-len(suffix):] == suffix:
+            ndim = value.ndim if hasattr(value, "ndim") else len(value.shape)
+            if len(axes) == ndim:
+                return axes
+            if len(axes) + 1 == ndim and "blocks" in path:
+                return ("layers",) + axes
+            break
+    else:
+        return base_axes(path, value)
+    raise ValueError(f"no logical axes for MoE param {'/'.join(path)}")
+
+
+def make_moe_lm_loss(aux_loss_weight: float = 0.02):
+    """LM loss + weighted load-balancing aux loss."""
+    from tf_operator_tpu.train.trainer import cross_entropy_loss
+
+    def moe_lm_loss(params, extra_vars, batch, model_apply):
+        tokens = batch["inputs"]
+        logits, aux = model_apply({"params": params}, tokens[:, :-1])
+        ce = cross_entropy_loss(logits, tokens[:, 1:], batch.get("mask"))
+        return ce + aux * aux_loss_weight, extra_vars
+
+    moe_lm_loss.model_inputs_fn = lambda b: (b["inputs"][:, :-1],)
+    return moe_lm_loss

@@ -1,0 +1,45 @@
+"""MNIST models (BASELINE config: "MNIST TFJob, 1 Worker (CPU, no PS)").
+
+Reference payload analog: examples/v1/dist-mnist/dist_mnist.py and
+examples/v1/mnist_with_summaries. A small CNN + a pure-MLP variant.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        # x: [B, 28, 28, 1] float32 in [0, 1]
+        x = nn.Conv(32, (5, 5), padding="SAME", name="conv1")(x)
+        x = nn.relu(nn.max_pool(x, (2, 2), strides=(2, 2)))
+        x = nn.Conv(64, (5, 5), padding="SAME", name="conv2")(x)
+        x = nn.relu(nn.max_pool(x, (2, 2), strides=(2, 2)))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(512, name="fc1")(x))
+        return nn.Dense(self.num_classes, name="fc2")(x)
+
+
+class MnistMLP(nn.Module):
+    num_classes: int = 10
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(self.hidden, name="fc1")(x))
+        return nn.Dense(self.num_classes, name="fc2")(x)
+
+
+def synthetic_batch(rng: jax.Array, batch_size: int = 64):
+    kx, ky = jax.random.split(rng)
+    return {
+        "inputs": jax.random.uniform(kx, (batch_size, 28, 28, 1)),
+        "labels": jax.random.randint(ky, (batch_size,), 0, 10),
+    }

@@ -1,0 +1,106 @@
+"""ResNet-50 (BASELINE headline config: images/sec/chip on ImageNet).
+
+Reference payload analog: the "ResNet-50/ImageNet TFJob, 1 Chief + 4
+Workers (MultiWorkerMirroredStrategy)" baseline — rebuilt as a flax model
+trained data-parallel under GSPMD (BN statistics become global-batch
+statistics automatically; XLA inserts the dp all-reduces over ICI).
+
+TPU notes: NHWC layout (XLA's preferred TPU conv layout), bfloat16
+activations with f32 BN/params, bias-free convs before BN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+
+def resnet50(num_classes: int = 1000) -> ResNetConfig:
+    return ResNetConfig(num_classes=num_classes)
+
+
+def resnet_tiny(num_classes: int = 10) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(1, 1), width=8, num_classes=num_classes)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        cfg = self.config
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype,
+                       param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=cfg.dtype,
+                       param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 name="conv2")(y)
+        y = nn.relu(norm(name="bn2")(y))
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides, self.strides),
+                            name="proj_conv")(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        cfg = self.config
+        x = x.astype(cfg.dtype)
+        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+                    name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(cfg.width * (2 ** stage), strides, cfg,
+                                    name=f"stage{stage}_block{block}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = x.astype(jnp.float32)
+        return nn.Dense(cfg.num_classes, name="classifier",
+                        param_dtype=jnp.float32)(x)
+
+
+def param_logical_axes(path, value):
+    """ResNet is pure data-parallel: params replicate (CNN_RULES)."""
+    ndim = value.ndim if hasattr(value, "ndim") else len(value.shape)
+    return (None,) * ndim
+
+
+def synthetic_batch(rng: jax.Array, batch_size: int = 128,
+                    image_size: int = 224, num_classes: int = 1000):
+    kx, ky = jax.random.split(rng)
+    return {
+        "inputs": jax.random.uniform(kx, (batch_size, image_size, image_size, 3)),
+        "labels": jax.random.randint(ky, (batch_size,), 0, num_classes),
+    }

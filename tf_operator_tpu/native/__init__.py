@@ -1,0 +1,106 @@
+"""ctypes bindings for the native batch generator (libbatchgen.so).
+
+Builds lazily via make on first use; all entry points degrade to numpy
+when the toolchain or library is unavailable, so the Python path never
+hard-depends on the native build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("tpu_operator.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libbatchgen.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(["make", "-C", _DIR], check=True,
+                               capture_output=True, timeout=120)
+            except Exception as e:
+                log.info("native batchgen unavailable (%s); using numpy", e)
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            log.info("failed to load %s (%s); using numpy", _LIB_PATH, e)
+            return None
+        lib.tpuop_fill_uniform_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_uint64]
+        lib.tpuop_fill_randint_i32.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64]
+        lib.tpuop_normalize_u8_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int32]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def fill_uniform(shape, seed: int) -> np.ndarray:
+    lib = _load()
+    out = np.empty(shape, np.float32)
+    if lib is None:
+        rng = np.random.default_rng(seed)
+        out[...] = rng.random(shape, dtype=np.float32)
+        return out
+    lib.tpuop_fill_uniform_f32(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size, ctypes.c_uint64(seed))
+    return out
+
+
+def fill_randint(shape, low: int, high: int, seed: int) -> np.ndarray:
+    lib = _load()
+    out = np.empty(shape, np.int32)
+    if lib is None:
+        rng = np.random.default_rng(seed)
+        out[...] = rng.integers(low, high, shape, dtype=np.int32)
+        return out
+    lib.tpuop_fill_randint_i32(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out.size, low, high, ctypes.c_uint64(seed))
+    return out
+
+
+def normalize_images(images_u8: np.ndarray, mean, std) -> np.ndarray:
+    """[..., C] uint8 -> float32 (x/255 - mean)/std per channel."""
+    images_u8 = np.ascontiguousarray(images_u8, dtype=np.uint8)
+    channels = images_u8.shape[-1]
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    lib = _load()
+    if lib is None:
+        return (images_u8.astype(np.float32) / 255.0 - mean) / std
+    out = np.empty(images_u8.shape, np.float32)
+    lib.tpuop_normalize_u8_f32(
+        images_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        images_u8.size // channels,
+        mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        channels)
+    return out

@@ -1,0 +1,2 @@
+"""Neural-net ops: norms, rotary embeddings, attention (reference impl,
+ring/context-parallel, and pallas TPU kernels)."""

@@ -1,0 +1,84 @@
+"""Core layers: RMSNorm, rotary position embeddings, attention.
+
+TPU-first choices: bfloat16 activations with float32 accumulation
+(``preferred_element_type``) so matmuls land on the MXU at full rate;
+shapes kept static and lane-aligned (head_dim/mlp multiples of 128 in
+real configs) so XLA tiles cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in f32 for numerical stability, cast back to input dtype."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    out = x * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32)).astype(dtype)
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int,
+                     theta: float = 10000.0) -> jax.Array:
+    """[max_seq_len, head_dim//2] complex rotation angles."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    return jnp.outer(t, inv_freq)  # [S, D/2]
+
+
+def apply_rope(x: jax.Array, angles: jax.Array,
+               positions: Optional[jax.Array] = None) -> jax.Array:
+    """Rotate [..., S, H, D] by position. ``angles`` is [max_S, D/2];
+    ``positions`` ([..., S]) defaults to arange."""
+    seq_len = x.shape[-3]
+    if positions is None:
+        freqs = angles[:seq_len]  # [S, D/2]
+    else:
+        freqs = angles[positions]  # [..., S, D/2]
+        freqs = jnp.expand_dims(freqs, axis=-2) if freqs.ndim == x.ndim - 1 \
+            else freqs
+    cos = jnp.cos(freqs)[..., :, None, :]  # [..., S, 1, D/2]
+    sin = jnp.sin(freqs)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """Grouped-query attention: repeat KV heads to match Q heads.
+    [..., S, KVH, D] -> [..., S, KVH*n_rep, D]."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=-2)
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              causal: bool = True,
+              mask: Optional[jax.Array] = None,
+              q_offset: int = 0) -> jax.Array:
+    """Reference (non-pallas) attention.
+
+    q: [B, S, H, D], k/v: [B, T, H, D] -> [B, S, H, D]. Softmax in f32.
+    ``q_offset`` shifts query positions for causal masking (ring/context
+    parallel blocks and decode).
+    """
+    *_, s, h, d = q.shape
+    t = k.shape[-3]
+    scale = d ** -0.5
+    logits = jnp.einsum("...shd,...thd->...hst", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = jnp.arange(s) + q_offset
+        k_pos = jnp.arange(t)
+        causal_mask = q_pos[:, None] >= k_pos[None, :]  # [S, T]
+        logits = jnp.where(causal_mask[None, None, :, :], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("...hst,...thd->...shd", weights, v)

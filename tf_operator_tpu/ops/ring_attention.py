@@ -1,0 +1,104 @@
+"""Ring attention: context/sequence parallelism over the ``sp`` mesh axis.
+
+The idiomatic TPU approach to long context (SURVEY §5 "long-context"):
+each device holds one sequence block of Q/K/V; K/V blocks rotate around
+the ring via ``ppermute`` (ICI neighbor transfers) while each device
+accumulates its queries' attention with an online (flash-style) softmax.
+Compute overlaps communication naturally — the ppermute for step t+1 is
+issued with step t's compute in flight under XLA's async collectives.
+
+Memory per device is O(S/n · S/n) per step instead of O(S²); the full
+sequence never materializes anywhere. Causality is enforced with global
+positions, so devices skip blocks that are entirely in their future.
+
+Reference: Liu et al., "Ring Attention with Blockwise Transformers"
+(PAPERS.md); this implementation is written fresh for shard_map.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "sp", causal: bool = True) -> jax.Array:
+    """SPMD collective attention; call inside shard_map/pjit-manual region.
+
+    q/k/v: per-device sequence blocks [B, S_blk, H, D] (block i of the
+    global sequence on ring position i). Returns [B, S_blk, H, D].
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s_blk, h, d = q.shape
+    scale = d ** -0.5
+
+    q32 = q.astype(jnp.float32)
+
+    def step(carry, _):
+        k_blk, v_blk, src_idx, num, den, m = carry
+
+        logits = jnp.einsum("bshd,bthd->bhst", q32,
+                            k_blk.astype(jnp.float32)) * scale
+        if causal:
+            q_pos = my_idx * s_blk + jnp.arange(s_blk)
+            k_pos = src_idx * s_blk + jnp.arange(s_blk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            block_visible = src_idx <= my_idx
+        else:
+            block_visible = jnp.bool_(True)
+
+        blk_max = jnp.max(logits, axis=-1)                      # [B,H,S]
+        m_new = jnp.maximum(m, blk_max)
+        # Guard fully-masked blocks: keep m finite so exp() stays sane.
+        m_safe = jnp.maximum(m_new, -1e30 / 2)
+        p = jnp.exp(logits - m_safe[..., None])                 # [B,H,S,T]
+        corr = jnp.exp(m - m_safe)                              # [B,H,S]
+        # corr is [B,H,S]; num is [B,S,H,D] -> align as [B,S,H,1]
+        corr_bs = corr.transpose(0, 2, 1)[..., None]
+        num_upd = (num * corr_bs
+                   + jnp.einsum("bhst,bthd->bshd", p, v_blk.astype(jnp.float32)))
+        den_upd = den * corr + jnp.sum(p, axis=-1)
+
+        num = jnp.where(block_visible, num_upd, num)
+        den = jnp.where(block_visible, den_upd, den)
+        m = jnp.where(block_visible, m_safe, m)
+
+        # Rotate K/V to the next ring position (receive from left neighbor).
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src_idx = jax.lax.ppermute(src_idx, axis_name, perm)
+        return (k_blk, v_blk, src_idx, num, den, m), None
+
+    num0 = jnp.zeros((b, s_blk, h, d), jnp.float32)
+    den0 = jnp.zeros((b, h, s_blk), jnp.float32)
+    m0 = jnp.full((b, h, s_blk), -1e30, jnp.float32)
+    carry0 = (k, v, my_idx, num0, den0, m0)
+    (k_f, v_f, _, num, den, m), _ = jax.lax.scan(
+        step, carry0, None, length=axis_size)
+
+    # den layout [B,H,S] -> [B,S,H,1]
+    out = num / jnp.maximum(den, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(mesh: Mesh, q: jax.Array, k: jax.Array,
+                           v: jax.Array, causal: bool = True,
+                           axis_name: str = "sp",
+                           batch_axes=("dcn", "dp", "fsdp"),
+                           head_axis: Optional[str] = "tp") -> jax.Array:
+    """Convenience wrapper: global [B, S, H, D] arrays -> ring attention
+    with S sharded over ``axis_name`` (and B/H over the data/tp axes)."""
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names)
+    spec = P(batch, axis_name, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

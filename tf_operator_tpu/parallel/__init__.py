@@ -1,0 +1,18 @@
+"""Parallelism: mesh construction, sharding rules, collectives, ring
+attention, pipeline parallelism.
+
+No reference analog — the reference's "parallelism" is process-topology
+orchestration (SURVEY §2.3); the actual distribution lived in user
+containers. Here it is first-class: GSPMD/pjit sharding (DP/FSDP/TP/EP),
+shard_map+ppermute for sequence/context (ring attention) and pipeline
+parallelism, over meshes derived from the slice topology (ICI axes) and
+slice count (DCN axis).
+"""
+
+from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401
+from tf_operator_tpu.parallel.sharding import (  # noqa: F401
+    LLAMA_RULES,
+    MOE_RULES,
+    logical_sharding,
+    shard_pytree,
+)

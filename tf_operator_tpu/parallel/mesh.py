@@ -1,0 +1,108 @@
+"""Device mesh construction.
+
+The mesh is the foundation of every sharding decision: ICI axes come from
+the slice topology, the DCN axis from the slice count ("How to Scale Your
+Model" recipe: pick a mesh, annotate shardings, let XLA insert
+collectives). Axis convention, outermost first:
+
+    ("dcn", "dp", "fsdp", "pp", "sp", "tp", "ep")
+
+- dcn: across slices (data parallel over DCN; multislice).
+- dp: pure data parallel (replicated params).
+- fsdp: data parallel with sharded params/optimizer (ZeRO-3).
+- pp: pipeline stages.
+- sp: sequence/context parallel (ring attention rides this axis).
+- tp: tensor parallel (megatron-style head/ffn sharding).
+- ep: expert parallel (MoE); typically aliased onto tp or its own axis.
+
+Axes of size 1 are kept in the mesh — PartitionSpecs can then mention
+every logical axis unconditionally and XLA drops the no-op collectives.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("dcn", "dp", "fsdp", "pp", "sp", "tp", "ep")
+
+# Ambient mesh: models reach it for nested shard_map regions (ring
+# attention, MoE dispatch) without threading a Mesh through module attrs.
+_MESH_STACK: list = []
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    _MESH_STACK.append(mesh)
+    try:
+        yield mesh
+    finally:
+        _MESH_STACK.pop()
+
+
+def active_mesh() -> Optional[Mesh]:
+    return _MESH_STACK[-1] if _MESH_STACK else None
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Axis sizes; -1 on at most one axis means 'absorb remaining devices'."""
+
+    dcn: int = 1
+    dp: int = -1
+    fsdp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes "
+                    f"product {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh axes product {fixed} != device count {n_devices}")
+        return sizes
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all local devices).
+
+    Device order follows jax.devices(), which enumerates ICI-adjacent
+    devices contiguously — putting the *innermost* (rightmost) mesh axes on
+    nearest neighbors. Bandwidth-hungry axes (tp/ep/sp) are rightmost in
+    AXIS_ORDER for exactly this reason; dcn is outermost so slices map to
+    the slowest links.
+    """
+    config = config or MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    sizes = config.resolve(devices.size)
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    return Mesh(devices.reshape(shape), AXIS_ORDER)
+
+
+def data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Mesh axes a [batch, ...] input's leading dim shards over."""
+    return tuple(a for a in ("dcn", "dp", "fsdp") if a in mesh.axis_names)
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    return math.prod(mesh.shape[a] for a in data_axes(mesh))

@@ -1,0 +1,77 @@
+"""Logical-axis sharding rules (t5x/maxtext-style).
+
+Parameters are annotated with *logical* axis names ("embed", "heads",
+"mlp", "expert", ...); rule tables map logical axes to mesh axes. This
+keeps models mesh-agnostic: the same Llama definition runs pure-DP,
+FSDP, TP, or any combination by swapping the rule table.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+Rules = Dict[str, MeshAxes]
+
+# Dense transformer (Llama/BERT family), megatron TP + FSDP:
+# - embed dim sharded over fsdp (ZeRO-3 gather on use)
+# - attention heads + ffn hidden sharded over tp
+# - vocab sharded over tp (output projection all-gather)
+LLAMA_RULES: Rules = {
+    "batch": ("dcn", "dp", "fsdp"),
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "seq": "sp",
+    "kv_seq": None,
+    "layers": None,
+    "norm": None,
+}
+
+# MoE (Mixtral family): experts sharded over ep, expert-internal mlp over tp.
+MOE_RULES: Rules = {
+    **LLAMA_RULES,
+    "expert": "ep",
+}
+
+# Conv/vision nets (ResNet): pure data parallel; params replicated.
+CNN_RULES: Rules = {
+    "batch": ("dcn", "dp", "fsdp"),
+}
+
+
+def spec_for(logical_axes: Sequence[Optional[str]], rules: Rules) -> P:
+    """Translate logical axis names to a PartitionSpec via the rule table."""
+    return P(*(rules.get(a) if a is not None else None
+               for a in logical_axes))
+
+
+def logical_sharding(mesh: Mesh, logical_axes: Sequence[Optional[str]],
+                     rules: Rules) -> NamedSharding:
+    return NamedSharding(mesh, spec_for(logical_axes, rules))
+
+
+def shard_pytree(tree, mesh: Mesh, axes_tree, rules: Rules):
+    """Place a pytree on the mesh: ``axes_tree`` mirrors ``tree`` with
+    logical-axis tuples (None = replicate)."""
+
+    def place(x, axes):
+        if axes is None:
+            sharding = NamedSharding(mesh, P())
+        else:
+            sharding = logical_sharding(mesh, axes, rules)
+        return jax.device_put(x, sharding)
+
+    return jax.tree.map(place, tree, axes_tree,
+                        is_leaf=lambda x: x is None)
+
+
+def batch_sharding(mesh: Mesh, rules: Rules = LLAMA_RULES) -> NamedSharding:
+    """Sharding for [batch, ...] host data."""
+    return NamedSharding(mesh, P(rules.get("batch")))

@@ -1,0 +1,1 @@
+"""Training harness: sharded state, train step, checkpointing, data."""

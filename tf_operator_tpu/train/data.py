@@ -1,0 +1,125 @@
+"""Input pipelines.
+
+Synthetic generators for benchmarking (host RNG off the critical path,
+double-buffered device_put), and the host-sharded feeding contract for
+multihost: each process feeds its addressable shard via
+``jax.make_array_from_process_local_data`` — the global array never
+exists on one host. See tf_operator_tpu/native for the C++ batch
+generator that moves image synthesis/augmentation off the Python GIL.
+"""
+
+from __future__ import annotations
+
+import threading
+import queue as queue_mod
+from typing import Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+
+class SyntheticLM:
+    """Deterministic token stream: [B, S+1] int32 batches."""
+
+    def __init__(self, batch_size: int, seq_len: int, vocab_size: int,
+                 seed: int = 0):
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        from tf_operator_tpu import native
+
+        seed = 0
+        while True:
+            seed += 1
+            yield {"inputs": native.fill_randint(
+                (self.batch_size, self.seq_len + 1), 0, self.vocab_size,
+                seed)}
+
+
+class SyntheticImages:
+    """[B, H, W, 3] float32 images + int labels."""
+
+    def __init__(self, batch_size: int, image_size: int = 224,
+                 num_classes: int = 1000, seed: int = 0):
+        self.batch_size = batch_size
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        from tf_operator_tpu import native
+
+        seed = 0
+        while True:
+            seed += 1
+            yield {
+                "inputs": native.fill_uniform(
+                    (self.batch_size, self.image_size, self.image_size, 3),
+                    seed),
+                "labels": native.fill_randint(
+                    (self.batch_size,), 0, self.num_classes, seed),
+            }
+
+
+class DeviceFeeder:
+    """Background thread that stages host batches onto the device(s) one
+    step ahead (hides host->HBM transfer behind compute)."""
+
+    def __init__(self, it: Iterator, sharding_tree, prefetch: int = 2):
+        self._it = iter(it)
+        self._sharding_tree = sharding_tree
+        self._q: queue_mod.Queue = queue_mod.Queue(maxsize=prefetch)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put that honors stop() even when the queue is full."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def _loop(self):
+        try:
+            for batch in self._it:
+                if self._stop.is_set():
+                    return
+                placed = jax.tree.map(
+                    lambda x, s: jax.device_put(x, s), batch,
+                    self._sharding_tree)
+                if not self._put(placed):
+                    return
+            self._put(StopIteration())  # finite iterator: wake the consumer
+        except Exception as e:  # surface in the consumer
+            self._put(e)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if isinstance(item, StopIteration):
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def stop(self):
+        self._stop.set()
+
+
+def multihost_batch(local_batch: Dict[str, np.ndarray],
+                    sharding_tree) -> Dict[str, jax.Array]:
+    """Assemble a global sharded batch from this process's local shard
+    (multihost feeding; each host loads only its slice)."""
+    return jax.tree.map(
+        lambda x, s: jax.make_array_from_process_local_data(s, x),
+        local_batch, sharding_tree)
