@@ -1,0 +1,3 @@
+from tf_operator_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,177 @@
+"""Operator CLI: ``python -m tf_operator_tpu`` — the process entrypoint.
+
+Reference parity: cmd/tf-operator.v1/main.go (flag parse, JSON logging,
+monitoring endpoint, version print) + app/options/options.go:53-83 (the
+flag surface) + app/server.go:72-196 (signal handling, leader election
+wrapping the controller run).
+
+Flag mapping (reference flag → here):
+  -namespace               → --namespace
+  -threadiness             → --threadiness
+  -version                 → --version
+  -json-log-format         → --json-log-format (default true, as reference)
+  -enable-gang-scheduling  → --enable-gang-scheduling
+  -monitoring-port         → --monitoring-port (default 8443)
+  -kube-api-qps/burst      → n/a (no remote API server in the local
+                             runtime; the K8s backend would add them)
+  -resync-period           → --resync-period (idle re-enqueue of all jobs)
+  -enable-leader-election  → --leader-elect / --no-leader-elect
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from tf_operator_tpu.operator import Operator
+from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.leaderelection import LeaderElector
+from tf_operator_tpu.runtime.logconfig import setup_logging
+from tf_operator_tpu.runtime.monitoring import MonitoringServer
+from tf_operator_tpu.version import version_string
+
+log = logging.getLogger("tpu_operator.cli")
+
+# Reference leader-election cadence (app/server.go:56-59).
+LEASE_DURATION = 15.0
+RENEW_DEADLINE = 5.0
+RETRY_PERIOD = 3.0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-operator",
+        description="TPU-native distributed-job operator")
+    p.add_argument("--namespace", default=os.environ.get(
+        "TPU_OPERATOR_NAMESPACE", ""),
+        help="watch a single namespace ('' = all namespaces)")
+    p.add_argument("--threadiness", type=int, default=1,
+                   help="number of concurrent sync workers")
+    p.add_argument("--version", action="store_true",
+                   help="print version and exit")
+    p.add_argument("--json-log-format", dest="json_log", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="structured JSON logs (default on)")
+    p.add_argument("--enable-gang-scheduling", action="store_true",
+                   help="gate pods behind all-or-nothing SliceGroup admission")
+    p.add_argument("--total-chips", type=int, default=None,
+                   help="chip capacity for gang admission (None = unlimited)")
+    p.add_argument("--monitoring-port", type=int, default=8443,
+                   help="port for /metrics, /healthz "
+                        "(0 = disabled, -1 = ephemeral)")
+    p.add_argument("--monitoring-host", default="127.0.0.1")
+    p.add_argument("--resync-period", type=float, default=30.0,
+                   help="idle full re-enqueue period in seconds (0 = off)")
+    p.add_argument("--leader-elect", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="run leader election before reconciling")
+    p.add_argument("--leader-elect-identity", default=None,
+                   help="lease holder identity (default: generated)")
+    return p
+
+
+class Server:
+    """Assembled operator process; separable from main() for tests."""
+
+    def __init__(self, args: argparse.Namespace,
+                 store: Optional[store_mod.Store] = None):
+        self.args = args
+        self.store = store or store_mod.Store()
+        self.operator = Operator(
+            store=self.store,
+            namespace=args.namespace or None,
+            enable_gang_scheduling=args.enable_gang_scheduling,
+            total_chips=args.total_chips)
+        self.monitoring: Optional[MonitoringServer] = None
+        if args.monitoring_port != 0:
+            self.monitoring = MonitoringServer(
+                port=max(args.monitoring_port, 0),
+                host=args.monitoring_host)
+        self.elector: Optional[LeaderElector] = None
+        if args.leader_elect:
+            self.elector = LeaderElector(
+                self.store,
+                identity=args.leader_elect_identity,
+                namespace=args.namespace or "default",
+                lease_duration=LEASE_DURATION,
+                renew_deadline=RENEW_DEADLINE,
+                retry_period=RETRY_PERIOD,
+                on_started_leading=self._start_reconciling,
+                on_stopped_leading=self._lost_lease)
+        self._stop = threading.Event()
+        self._resync_thread: Optional[threading.Thread] = None
+
+    def _start_reconciling(self) -> None:
+        self.operator.start(threadiness=self.args.threadiness)
+        if self.args.resync_period > 0:
+            self._resync_thread = threading.Thread(
+                target=self._resync_loop, name="resync", daemon=True)
+            self._resync_thread.start()
+
+    def _lost_lease(self) -> None:
+        # The reference fatals on lost leadership (server.go:178-182): a
+        # stale leader must not keep writing. Same policy.
+        log.error("leader lease lost; shutting down")
+        self.shutdown()
+
+    def _resync_loop(self) -> None:
+        """Level-triggered safety net: periodically re-enqueue every job
+        (reference: 15s ReconcilerSyncLoopPeriod via informer resync)."""
+        while not self._stop.wait(self.args.resync_period):
+            for job in self.store.list(store_mod.TPUJOBS):
+                self.operator.controller.enqueue(job.key())
+
+    def start(self) -> None:
+        if self.monitoring is not None:
+            self.monitoring.start()
+        if self.elector is not None:
+            self.elector.start()
+        else:
+            self._start_reconciling()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self.elector is not None:
+            self.elector.stop()
+        self.operator.stop()
+        if self.monitoring is not None:
+            self.monitoring.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        print(version_string())
+        return 0
+    setup_logging(json_format=args.json_log)
+    log.info("%s starting", version_string())
+
+    server = Server(args)
+    stop_event = threading.Event()
+    signal_count = [0]
+
+    def _on_signal(signum, frame):
+        # First signal: graceful stop. Second: hard exit (reference
+        # vendored signals/signal.go:29-45 semantics).
+        signal_count[0] += 1
+        if signal_count[0] > 1:
+            os._exit(1)
+        log.info("received signal %d; shutting down", signum)
+        stop_event.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    server.start()
+    stop_event.wait()
+    server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

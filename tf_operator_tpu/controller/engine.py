@@ -51,6 +51,7 @@ from tf_operator_tpu.controller.expectations import (
     ControllerExpectations,
     expectation_key,
 )
+from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime.events import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING, Recorder
 from tf_operator_tpu.runtime.workqueue import RateLimitingQueue
 
@@ -328,6 +329,14 @@ class JobEngine:
                     log.info("restarting pod %s (exit code %d)",
                              pod.metadata.name, exit_code)
                     self._delete_pod(job, pod, rt)
+                    metrics.restarted_pods.inc(
+                        job_namespace=job.metadata.namespace)
+                    if cond.get_condition(job.status,
+                                          JobConditionType.RESTARTING) is None:
+                        # One job-restart event per Restarting transition,
+                        # not per restarted pod (reference tfJobsRestartCount).
+                        metrics.jobs_restarted.inc(
+                            job_namespace=job.metadata.namespace)
                     msg = (f"TPUJob {job.metadata.name} is restarting because "
                            f"{rt} replica(s) failed.")
                     self.recorder.event(job, EVENT_TYPE_WARNING,

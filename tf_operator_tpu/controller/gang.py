@@ -29,6 +29,7 @@ from tf_operator_tpu.api.types import (
 )
 from tf_operator_tpu.controller.control import controller_owner_ref
 from tf_operator_tpu.controller.engine import GangScheduler
+from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod
 from tf_operator_tpu.runtime.store import Store
 
@@ -89,14 +90,22 @@ class SliceGangScheduler(GangScheduler):
             group.metadata.labels = {constants.LABEL_JOB_NAME: job.metadata.name}
             group.metadata.owner_references = [controller_owner_ref(job)]
             self.store.create(store_mod.SLICEGROUPS, group)
+            metrics.slicegroups_created.inc(
+                job_namespace=job.metadata.namespace)
         elif existing.spec.to_dict() != desired_spec.to_dict():
             existing.spec = desired_spec
             self.store.update(store_mod.SLICEGROUPS, existing)
         self._admit()
 
     def delete_slice_group(self, job: TPUJob) -> None:
+        existing = self.store.try_get(store_mod.SLICEGROUPS,
+                                      job.metadata.namespace,
+                                      job.metadata.name)
+        if existing is None:
+            return
         self.store.try_delete(store_mod.SLICEGROUPS, job.metadata.namespace,
                               job.metadata.name)
+        metrics.slicegroups_deleted.inc(job_namespace=job.metadata.namespace)
         self._admit()  # freed capacity may admit queued groups
 
     def annotate_pod(self, job: TPUJob, pod: Pod, rtype: str) -> None:

@@ -31,6 +31,7 @@ from tf_operator_tpu.api.types import (
 )
 from tf_operator_tpu.controller import conditions as cond
 from tf_operator_tpu.controller.engine import JobEngine
+from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime.events import EVENT_TYPE_NORMAL, Recorder
 from tf_operator_tpu.runtime.workqueue import RateLimitingQueue
 
@@ -128,12 +129,28 @@ def update_job_status(job: TPUJob, replica_specs: Dict[str, ReplicaSpec],
                                    cond.JOB_FAILED_REASON, msg)
                 if status.completion_time is None:
                     status.completion_time = now
+                if not cond.is_failed(status):
+                    metrics.jobs_failed.inc(
+                        job_namespace=job.metadata.namespace)
                 cond.update_job_conditions(status, JobConditionType.FAILED,
                                            cond.JOB_FAILED_REASON, msg)
 
 
 def _set_running(job: TPUJob, recorder: Optional[Recorder]) -> None:
     msg = f"TPUJob {job.key()} is running."
+    first_run = (not cond.is_running(job.status)
+                 and cond.get_condition(job.status,
+                                        JobConditionType.RESTARTING) is None)
+    if first_run and job.metadata.creation_timestamp is not None:
+        # Creation-to-Running latency: the BASELINE pod-to-AllReplicasReady
+        # north star, observed on the FIRST Running transition only — a
+        # restart->Running re-transition carries a Restarting condition
+        # (Running/Restarting mutual exclusion) and is excluded.
+        dt = (_dt.datetime.now(_dt.timezone.utc)
+              - job.metadata.creation_timestamp).total_seconds()
+        if dt >= 0:
+            metrics.ready_latency_seconds.observe(
+                dt, job_namespace=job.metadata.namespace)
     cond.update_job_conditions(job.status, JobConditionType.RUNNING,
                                cond.JOB_RUNNING_REASON, msg)
 
@@ -144,5 +161,7 @@ def _set_succeeded(job: TPUJob, recorder: Optional[Recorder]) -> None:
         recorder.event(job, EVENT_TYPE_NORMAL, cond.JOB_SUCCEEDED_REASON, msg)
     if job.status.completion_time is None:
         job.status.completion_time = _dt.datetime.now(_dt.timezone.utc)
+    if not cond.is_succeeded(job.status):
+        metrics.jobs_successful.inc(job_namespace=job.metadata.namespace)
     cond.update_job_conditions(job.status, JobConditionType.SUCCEEDED,
                                cond.JOB_SUCCEEDED_REASON, msg)

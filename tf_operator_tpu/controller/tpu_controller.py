@@ -35,6 +35,7 @@ from tf_operator_tpu.controller.expectations import (
     ControllerExpectations,
     expectation_key,
 )
+from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod
 from tf_operator_tpu.runtime.events import (
     EVENT_TYPE_NORMAL,
@@ -75,6 +76,7 @@ class StorePodControl(PodControl):
         self.recorder.event(job, EVENT_TYPE_NORMAL,
                             SUCCESSFUL_CREATE_POD_REASON,
                             f"Created pod: {pod.metadata.name}")
+        metrics.created_pods.inc(job_namespace=namespace)
 
     def delete_pod(self, namespace: str, name: str, job: TPUJob) -> None:
         try:
@@ -89,6 +91,7 @@ class StorePodControl(PodControl):
         self.recorder.event(job, EVENT_TYPE_NORMAL,
                             SUCCESSFUL_DELETE_POD_REASON,
                             f"Deleted pod: {name}")
+        metrics.deleted_pods.inc(job_namespace=namespace)
 
 
 class StoreEndpointControl(EndpointControl):
@@ -101,12 +104,14 @@ class StoreEndpointControl(EndpointControl):
         endpoint.metadata.namespace = namespace
         endpoint.metadata.owner_references = [controller_owner_ref(job)]
         self.store.create(store_mod.ENDPOINTS, endpoint)
+        metrics.created_endpoints.inc(job_namespace=namespace)
 
     def delete_endpoint(self, namespace: str, name: str, job: TPUJob) -> None:
         try:
             self.store.delete(store_mod.ENDPOINTS, namespace, name)
         except store_mod.NotFoundError:
-            pass
+            return
+        metrics.deleted_endpoints.inc(job_namespace=namespace)
 
 
 class TPUJobController(JobPlugin):
@@ -148,7 +153,14 @@ class TPUJobController(JobPlugin):
     def _on_job_event(self, event_type: str, job: TPUJob) -> None:
         if self.namespace and job.metadata.namespace != self.namespace:
             return
-        if event_type == DELETED:
+        if event_type == ADDED:
+            # A replayed ADD (informer initial list after a controller
+            # restart / failover) carries the conditions a prior sync wrote;
+            # only genuinely-new jobs count as created.
+            if not job.status.conditions:
+                metrics.jobs_created.inc(job_namespace=job.metadata.namespace)
+        elif event_type == DELETED:
+            metrics.jobs_deleted.inc(job_namespace=job.metadata.namespace)
             self.expectations.delete_for_job(job.key())
             self._garbage_collect(job)
         self.enqueue(job.key())
@@ -205,6 +217,7 @@ class TPUJobController(JobPlugin):
 
     def enqueue(self, job_key: str) -> None:
         self.workqueue.add(job_key)
+        metrics.workqueue_depth.set(len(self.workqueue))
 
     # ------------------------------------------------------------------
     # Worker loop (reference controller.go:191-284)
@@ -234,6 +247,7 @@ class TPUJobController(JobPlugin):
                 continue
             except ShutDown:
                 return
+            metrics.workqueue_depth.set(len(self.workqueue))
             try:
                 self.sync_tpujob(key)
             except Exception:
@@ -273,6 +287,8 @@ class TPUJobController(JobPlugin):
             # re-enqueue -> write, a hot loop.
             old_status = job.status.deepcopy()
             msg = f"TPUJob {key} is not valid: {e}"
+            if not cond.is_failed(job.status):
+                metrics.jobs_failed.inc(job_namespace=namespace)
             cond.update_job_conditions(job.status, JobConditionType.FAILED,
                                        "InvalidTPUJobSpec", msg)
             if job.status.to_dict() != old_status.to_dict():
@@ -290,7 +306,8 @@ class TPUJobController(JobPlugin):
         if not needs_sync:
             log.debug("expectations pending for %s; skipping sync", key)
             return
-        self.engine.reconcile_jobs(job)
+        with metrics.reconcile_seconds.time():
+            self.engine.reconcile_jobs(job)
 
     # ------------------------------------------------------------------
     # JobPlugin implementation (reference ControllerInterface)

@@ -1,0 +1,195 @@
+"""Leader election: lease-based, exactly-one-active-reconciler.
+
+Reference parity: cmd/tf-operator.v1/app/server.go:146-193 —
+client-go leaderelection.RunOrDie over a resourcelock.EndpointsLock
+("tf-operator" in the operator namespace) with LeaseDuration 15s,
+RenewDeadline 5s, RetryPeriod 3s; OnStartedLeading runs the controller,
+OnStoppedLeading fatals; the tf_operator_is_leader gauge flips at
+server.go:65-69 and :175-182.
+
+TPU-native shape: the lock record is a Lease object in the object store
+(status-subresource-free, optimistic-concurrency CAS on update). With a
+K8s backend the same protocol maps onto coordination.k8s.io/v1 Lease.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import logging
+import threading
+import uuid
+from typing import Callable, Optional
+
+from tf_operator_tpu.api.types import ApiObject, ObjectMeta
+from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.metrics import is_leader as is_leader_gauge
+from tf_operator_tpu.runtime.store import Store
+
+log = logging.getLogger("tpu_operator.leaderelection")
+
+LEASES = "leases"
+DEFAULT_LOCK_NAME = "tpu-operator"
+
+
+def _now() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+@dataclasses.dataclass
+class LeaseSpec(ApiObject):
+    holder_identity: str = ""
+    lease_duration_seconds: float = 15.0
+    acquire_time: Optional[_dt.datetime] = None
+    renew_time: Optional[_dt.datetime] = None
+    lease_transitions: int = 0
+
+
+@dataclasses.dataclass
+class _EmptyStatus(ApiObject):
+    pass
+
+
+@dataclasses.dataclass
+class Lease(ApiObject):
+    metadata: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: LeaseSpec = dataclasses.field(default_factory=LeaseSpec)
+    status: _EmptyStatus = dataclasses.field(default_factory=_EmptyStatus)
+
+
+class LeaderElector:
+    """Acquire-then-renew loop. ``on_started_leading`` runs (once) in a
+    daemon thread after acquisition; ``on_stopped_leading`` fires if a
+    renewal misses the deadline (the reference fatals there)."""
+
+    def __init__(self, store: Store,
+                 identity: Optional[str] = None,
+                 namespace: str = "default",
+                 name: str = DEFAULT_LOCK_NAME,
+                 lease_duration: float = 15.0,
+                 renew_deadline: float = 5.0,
+                 retry_period: float = 3.0,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None):
+        if renew_deadline >= lease_duration:
+            raise ValueError("renew_deadline must be < lease_duration")
+        self.store = store
+        self.identity = identity or f"{DEFAULT_LOCK_NAME}-{uuid.uuid4().hex[:8]}"
+        self.namespace = namespace
+        self.name = name
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._leading = threading.Event()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading.is_set()
+
+    def wait_until_leading(self, timeout: Optional[float] = None) -> bool:
+        return self._leading.wait(timeout)
+
+    # -- lock record CAS -------------------------------------------------
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = _now()
+        lease = self.store.try_get(LEASES, self.namespace, self.name)
+        if lease is None:
+            fresh = Lease(spec=LeaseSpec(
+                holder_identity=self.identity,
+                lease_duration_seconds=self.lease_duration,
+                acquire_time=now, renew_time=now))
+            fresh.metadata.name = self.name
+            fresh.metadata.namespace = self.namespace
+            try:
+                self.store.create(LEASES, fresh)
+                return True
+            except store_mod.AlreadyExistsError:
+                return False
+
+        if lease.spec.holder_identity != self.identity:
+            renew = lease.spec.renew_time
+            expired = (renew is None or
+                       (now - renew).total_seconds()
+                       > lease.spec.lease_duration_seconds)
+            if not expired:
+                return False
+            lease.spec.lease_transitions += 1
+            lease.spec.acquire_time = now
+            log.info("%s taking over expired lease from %s", self.identity,
+                     lease.spec.holder_identity)
+
+        lease.spec.holder_identity = self.identity
+        lease.spec.renew_time = now
+        lease.spec.lease_duration_seconds = self.lease_duration
+        try:
+            # Optimistic CAS: resource_version mismatch = lost the race.
+            self.store.update(LEASES, lease)
+            return True
+        except (store_mod.ConflictError, store_mod.NotFoundError):
+            return False
+
+    def release(self) -> None:
+        """Voluntarily drop the lease so a standby takes over instantly."""
+        lease = self.store.try_get(LEASES, self.namespace, self.name)
+        if lease is not None and lease.spec.holder_identity == self.identity:
+            lease.spec.holder_identity = ""
+            lease.spec.renew_time = None
+            try:
+                self.store.update(LEASES, lease)
+            except (store_mod.ConflictError, store_mod.NotFoundError):
+                pass
+
+    # -- run loop --------------------------------------------------------
+
+    def run(self) -> None:
+        """Blocks until elected, then renews until stop() or lost lease."""
+        while not self._stop.is_set():
+            if self._try_acquire_or_renew():
+                break
+            if self._stop.wait(self.retry_period):
+                return
+        if self._stop.is_set():
+            return
+
+        log.info("%s became leader", self.identity)
+        self._leading.set()
+        is_leader_gauge.set(1)
+        if self.on_started_leading is not None:
+            threading.Thread(target=self.on_started_leading,
+                             name="leading", daemon=True).start()
+
+        renew_every = min(self.renew_deadline / 2.0, 2.0)
+        while not self._stop.wait(renew_every):
+            deadline = _now() + _dt.timedelta(seconds=self.renew_deadline)
+            renewed = False
+            while _now() < deadline and not self._stop.is_set():
+                if self._try_acquire_or_renew():
+                    renewed = True
+                    break
+                self._stop.wait(min(self.retry_period, 0.5))
+            if not renewed:
+                log.error("%s failed to renew lease; stepping down",
+                          self.identity)
+                break
+        self._leading.clear()
+        is_leader_gauge.set(0)
+        if not self._stop.is_set() and self.on_stopped_leading is not None:
+            self.on_stopped_leading()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, name="leaderelect",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._leading.clear()
+        is_leader_gauge.set(0)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.release()

@@ -1,0 +1,276 @@
+"""Operator metrics: counters/gauges/histograms + Prometheus exposition.
+
+Reference parity: the promauto counters sprinkled through the reference
+controller (pkg/controller.v1/tensorflow/job.go:29-36 jobs created/
+deleted/restarted, status.go:47-61 successful/failed, pod.go:56-63
+restarted pods, vendored common/pod.go:57-70 created/deleted pods,
+common/service.go:36-45 service creations, common/job_controller.go:41-57
+PodGroups, cmd/tf-operator.v1/app/server.go:65-69 is_leader gauge) and
+the /metrics endpoint (cmd/tf-operator.v1/main.go:39-50). The catalog is
+documented in docs/monitoring.md, mirroring the reference's
+docs/monitoring/README.md.
+
+No prometheus_client dependency: the registry renders the text
+exposition format (v0.0.4) itself, which is all a scraper needs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str]):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], float] = {}
+
+    kind = "untyped"
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != "
+                f"declared {sorted(self.label_names)}")
+        return tuple(labels[n] for n in self.label_names)
+
+    def collect(self) -> List[Tuple[Tuple[str, ...], float]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def _render_labels(self, values: Tuple[str, ...]) -> str:
+        if not self.label_names:
+            return ""
+        inner = ",".join(
+            f'{n}="{_escape(v)}"' for n, v in zip(self.label_names, values))
+        return "{" + inner + "}"
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        samples = self.collect()
+        if not samples and not self.label_names:
+            samples = [((), 0.0)]
+        for values, v in samples:
+            lines.append(f"{self.name}{self._render_labels(values)} {_fmt(v)}")
+        return lines
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._children.get(self._key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._children.get(self._key(labels), 0.0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (used for reconcile + ready latency)."""
+
+    kind = "histogram"
+
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                       2.5, 5.0, 10.0, 30.0, 60.0)
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str],
+                 buckets: Optional[Sequence[float]] = None):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def time(self, **labels: str) -> "_Timer":
+        return _Timer(self, labels)
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key in sorted(self._counts):
+                counts = self._counts[key]
+                for i, ub in enumerate(self.buckets):
+                    labels = dict(zip(self.label_names, key))
+                    labels["le"] = _fmt(ub)
+                    inner = ",".join(f'{n}="{_escape(v)}"'
+                                     for n, v in labels.items())
+                    lines.append(
+                        f"{self.name}_bucket{{{inner}}} {counts[i]}")
+                base = self._render_labels(key)
+                inf_labels = dict(zip(self.label_names, key))
+                inf_labels["le"] = "+Inf"
+                inner = ",".join(f'{n}="{_escape(v)}"'
+                                 for n, v in inf_labels.items())
+                lines.append(f"{self.name}_bucket{{{inner}}} "
+                             f"{self._totals[key]}")
+                lines.append(f"{self.name}_sum{base} "
+                             f"{_fmt(self._sums[key])}")
+                lines.append(f"{self.name}_count{base} {self._totals[key]}")
+        return lines
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: Dict[str, str]):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0, **self.labels)
+        return False
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name: str, help_: str,
+                labels: Sequence[str] = ()) -> Counter:
+        return self._register(Counter(name, help_, labels))  # type: ignore
+
+    def gauge(self, name: str, help_: str,
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge(name, help_, labels))  # type: ignore
+
+    def histogram(self, name: str, help_: str, labels: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._register(
+            Histogram(name, help_, labels, buckets))  # type: ignore
+
+    def render_text(self) -> str:
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        out: List[str] = []
+        for m in metrics:
+            out.extend(m.render())
+        return "\n".join(out) + "\n"
+
+    def reset(self) -> None:
+        """Test helper: drop all recorded samples, keep registrations."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            with m._lock:
+                m._children.clear()
+                if isinstance(m, Histogram):
+                    m._counts.clear()
+                    m._sums.clear()
+                    m._totals.clear()
+
+
+REGISTRY = Registry()
+
+# --- the catalog (docs/monitoring.md; names mirror the reference's) -------
+
+jobs_created = REGISTRY.counter(
+    "tpu_operator_jobs_created_total",
+    "Counts number of TPU jobs created", ["job_namespace"])
+jobs_deleted = REGISTRY.counter(
+    "tpu_operator_jobs_deleted_total",
+    "Counts number of TPU jobs deleted", ["job_namespace"])
+jobs_successful = REGISTRY.counter(
+    "tpu_operator_jobs_successful_total",
+    "Counts number of TPU jobs successful", ["job_namespace"])
+jobs_failed = REGISTRY.counter(
+    "tpu_operator_jobs_failed_total",
+    "Counts number of TPU jobs failed", ["job_namespace"])
+jobs_restarted = REGISTRY.counter(
+    "tpu_operator_jobs_restarted_total",
+    "Counts number of TPU jobs restarted", ["job_namespace"])
+created_pods = REGISTRY.counter(
+    "tpu_operator_created_pods_total",
+    "Counts number of pods created by the operator", ["job_namespace"])
+deleted_pods = REGISTRY.counter(
+    "tpu_operator_deleted_pods_total",
+    "Counts number of pods deleted by the operator", ["job_namespace"])
+restarted_pods = REGISTRY.counter(
+    "tpu_operator_restarted_pods_total",
+    "Counts number of pods restarted with identity", ["job_namespace"])
+created_endpoints = REGISTRY.counter(
+    "tpu_operator_created_endpoints_total",
+    "Counts number of per-replica endpoints created", ["job_namespace"])
+deleted_endpoints = REGISTRY.counter(
+    "tpu_operator_deleted_endpoints_total",
+    "Counts number of per-replica endpoints deleted", ["job_namespace"])
+slicegroups_created = REGISTRY.counter(
+    "tpu_operator_slicegroups_created_total",
+    "Counts number of gang SliceGroups created", ["job_namespace"])
+slicegroups_deleted = REGISTRY.counter(
+    "tpu_operator_slicegroups_deleted_total",
+    "Counts number of gang SliceGroups deleted", ["job_namespace"])
+is_leader = REGISTRY.gauge(
+    "tpu_operator_is_leader",
+    "1 while this operator replica holds the leader lease")
+reconcile_seconds = REGISTRY.histogram(
+    "tpu_operator_reconcile_duration_seconds",
+    "Wall time of one job reconcile")
+ready_latency_seconds = REGISTRY.histogram(
+    "tpu_operator_all_replicas_ready_seconds",
+    "Job creation to all-replicas-Running latency (BASELINE north star)",
+    ["job_namespace"],
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0))
+workqueue_depth = REGISTRY.gauge(
+    "tpu_operator_workqueue_depth",
+    "Items waiting in the controller workqueue")

@@ -27,17 +27,17 @@ class SyntheticLM:
         self.batch_size = batch_size
         self.seq_len = seq_len
         self.vocab_size = vocab_size
-        self._rng = np.random.default_rng(seed)
+        self.seed = seed
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         from tf_operator_tpu import native
 
-        seed = 0
+        step = 0
         while True:
-            seed += 1
+            step += 1
             yield {"inputs": native.fill_randint(
                 (self.batch_size, self.seq_len + 1), 0, self.vocab_size,
-                seed)}
+                (self.seed << 20) + step)}
 
 
 class SyntheticImages:
@@ -48,20 +48,21 @@ class SyntheticImages:
         self.batch_size = batch_size
         self.image_size = image_size
         self.num_classes = num_classes
-        self._rng = np.random.default_rng(seed)
+        self.seed = seed
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         from tf_operator_tpu import native
 
-        seed = 0
+        step = 0
         while True:
-            seed += 1
+            step += 1
+            s = (self.seed << 20) + step
             yield {
                 "inputs": native.fill_uniform(
                     (self.batch_size, self.image_size, self.image_size, 3),
-                    seed),
+                    s),
                 "labels": native.fill_randint(
-                    (self.batch_size,), 0, self.num_classes, seed),
+                    (self.batch_size,), 0, self.num_classes, s),
             }
 
 
@@ -105,7 +106,13 @@ class DeviceFeeder:
         return self
 
     def __next__(self):
-        item = self._q.get()
+        while True:
+            try:
+                item = self._q.get(timeout=0.2)
+                break
+            except queue_mod.Empty:
+                if self._stop.is_set():
+                    raise StopIteration
         if isinstance(item, StopIteration):
             raise StopIteration
         if isinstance(item, Exception):
@@ -114,6 +121,13 @@ class DeviceFeeder:
 
     def stop(self):
         self._stop.set()
+        # Drain so a producer blocked in _put can observe the stop flag,
+        # and wake any consumer blocked before the flag was set.
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue_mod.Empty:
+            pass
 
 
 def multihost_batch(local_batch: Dict[str, np.ndarray],
