@@ -44,8 +44,10 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    # "" = plain attention; "ring" = ring attention over sp (call must be
-    # inside shard_map; the trainer arranges this when sp > 1).
+    # "" = auto (pallas flash on TPU when shapes tile, else XLA);
+    # "flash" = force the pallas kernel; "xla" = force the reference;
+    # "ring" = ring attention over sp (call must be inside shard_map;
+    # the trainer arranges this when sp > 1).
     attention_impl: str = ""
     sp_axis: str = "sp"
 
@@ -101,8 +103,13 @@ class LlamaAttention(nn.Module):
                                   causal=True),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                 check_vma=False)(q, k, v)
-        else:
+        elif cfg.attention_impl == "xla":
             out = attention(q, k, v, causal=True)
+        else:  # "" = auto, "flash" = force the pallas kernel
+            from tf_operator_tpu.ops.flash_attention import best_attention
+            from tf_operator_tpu.parallel.mesh import active_mesh
+            out = best_attention(q, k, v, causal=True, mesh=active_mesh(),
+                                 force_flash=cfg.attention_impl == "flash")
 
         out = out.reshape(b, s, cfg.n_heads * cfg.head_dim)
         return dense(cfg.hidden, "wo")(out)

@@ -1,0 +1,364 @@
+"""Pallas TPU flash attention (forward + backward kernels).
+
+The attention hot path for the model harness (the compute plane the
+reference delegated to user containers — SURVEY §2.3). Design targets
+the MXU/VMEM structure from the pallas guide:
+
+- online-softmax forward: Q blocks stay resident in VMEM while K/V
+  blocks stream through; the S×T score matrix never hits HBM
+  (O(block_q · block_k) VMEM instead of O(S·T) HBM);
+- causal blocks that are entirely masked are skipped (`pl.when` on the
+  block-visibility predicate), halving causal FLOPs;
+- all matmuls run on the MXU with f32 accumulation
+  (`preferred_element_type`), activations stay in the input dtype
+  (bf16 in the real configs) on the HBM side;
+- backward recomputes scores from the saved logsumexp (flash-style):
+  one kernel accumulates dQ over K blocks, one accumulates dK/dV over
+  Q blocks — no attention matrix is ever materialized.
+
+Falls back to the XLA reference implementation (`ops.layers.attention`)
+off-TPU or for shapes that do not tile (`flash_supported`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _dot(a, b, trans_b=False):
+    dims = (((1,), (1 if trans_b else 0,)), ((), ()))
+    return jax.lax.dot_general(a, b, dimension_numbers=dims,
+                               preferred_element_type=jnp.float32)
+
+
+def _causal_mask(scores, i, j, block_q, block_k, q_offset):
+    """Mask scores (block_q, block_k) at q block i / k block j."""
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) \
+        + i * block_q + q_offset
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) \
+        + j * block_k
+    return jnp.where(q_pos >= k_pos, scores, NEG_INF)
+
+
+def _block_visible(i, j, block_q, block_k, q_offset, causal):
+    """Causal: k block j contributes to q block i iff its first key
+    position <= the block's last query position."""
+    if not causal:
+        return jnp.bool_(True)
+    return j * block_k <= i * block_q + q_offset + block_q - 1
+
+
+def _scores(q_ref, k_ref, i, j, scale, block_q, block_k, q_offset, causal):
+    """Recompute the (block_q, block_k) f32 score block."""
+    s = _dot(q_ref[0, 0], k_ref[0, 0], trans_b=True) * scale
+    if causal:
+        s = _causal_mask(s, i, j, block_q, block_k, q_offset)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *,
+                causal: bool, scale: float, block_q: int, block_k: int,
+                q_offset: int):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m[...] = jnp.full_like(m, NEG_INF)
+        l[...] = jnp.zeros_like(l)
+        acc[...] = jnp.zeros_like(acc)
+
+    @pl.when(_block_visible(i, j, block_q, block_k, q_offset, causal))
+    def _compute():
+        s = _scores(q_ref, k_ref, i, j, scale, block_q, block_k,
+                    q_offset, causal)                   # (bq, bk) f32
+        m_prev = m[:, 0]                                # (bq,)
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        corr = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next[:, None])                # (bq, bk) f32
+        l[...] = jnp.broadcast_to(
+            (l[:, 0] * corr + jnp.sum(p, axis=1))[:, None], l.shape)
+        m[...] = jnp.broadcast_to(m_next[:, None], m.shape)
+        acc[...] = acc[...] * corr[:, None] + _dot(
+            p.astype(v_ref.dtype), v_ref[0, 0])
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l_safe = jnp.where(l[:, 0] == 0.0, 1.0, l[:, 0])
+        o_ref[0, 0] = (acc[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m[:, 0] + jnp.log(l_safe)
+
+
+def _fwd(q, k, v, causal, q_offset, block_q, block_k, interpret
+         ) -> Tuple[jax.Array, jax.Array]:
+    """q/k/v: [B, H, S, D] -> (out [B,H,S,D], lse [B,H,S])."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    nq, nk = sq // block_q, sk // block_k
+    scale = d ** -0.5
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k,
+                               q_offset=q_offset)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, causal: bool, scale: float, block_q: int,
+               block_k: int, q_offset: int):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    @pl.when(_block_visible(i, j, block_q, block_k, q_offset, causal))
+    def _compute():
+        s = _scores(q_ref, k_ref, i, j, scale, block_q, block_k,
+                    q_offset, causal)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])          # (bq, bk) f32
+        dp = _dot(do_ref[0, 0], v_ref[0, 0], trans_b=True)
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dq_acc[...] += _dot(ds.astype(k_ref.dtype), k_ref[0, 0])
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
+                scale: float, block_q: int, block_k: int, q_offset: int):
+    j, i = pl.program_id(2), pl.program_id(3)   # k block outer, q block inner
+    nq = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_block_visible(i, j, block_q, block_k, q_offset, causal))
+    def _compute():
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        s = _scores(q_ref, k_ref, i, j, scale, block_q, block_k,
+                    q_offset, causal)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])          # (bq, bk) f32
+        dv_acc[...] += _dot(p.astype(do.dtype).T, do)
+        dp = _dot(do, v_ref[0, 0], trans_b=True)
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dk_acc[...] += _dot(ds.astype(q.dtype).T, q)
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_impl(q, k, v, out, lse, do, causal, q_offset, block_q, block_k,
+              interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    nq, nk = sq // block_q, sk // block_k
+    scale = d ** -0.5
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                              # [B,H,S]
+
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0),
+                         memory_space=pltpu.VMEM)
+    rowq = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i),
+                        memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k,
+                          q_offset=q_offset),
+        grid=(b, h, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)[0]
+
+    # dK/dV: iterate q blocks innermost for each k block.
+    qspec_t = pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0),
+                           memory_space=pltpu.VMEM)
+    kspec_t = pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0),
+                           memory_space=pltpu.VMEM)
+    rowq_t = pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i),
+                          memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k,
+                          q_offset=q_offset),
+        grid=(b, h, nk, nq),
+        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, rowq_t, rowq_t],
+        out_specs=[kspec_t, kspec_t],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, q_offset, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, causal, q_offset, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, q_offset, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, causal, q_offset, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, q_offset, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    return _bwd_impl(q, k, v, out, lse, do, causal, q_offset, block_q,
+                     block_k, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_supported(q_seq: int, k_seq: int, head_dim: int,
+                    block_q: int = 128, block_k: int = 128) -> bool:
+    """Shapes must tile into sublane-aligned blocks; head_dim must fill
+    MXU lanes."""
+    bq, bk = min(block_q, q_seq), min(block_k, k_seq)
+    return (q_seq % bq == 0 and bq % 8 == 0
+            and k_seq % bk == 0 and bk % 8 == 0
+            and head_dim % _LANES == 0 and head_dim <= 512)
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, q_offset: int = 0,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False) -> jax.Array:
+    """Flash attention over [B, S, H, D] tensors (same layout as
+    ``ops.layers.attention``). Requires `flash_supported` shapes."""
+    bq = min(block_q, q.shape[1])
+    bk = min(block_k, k.shape[1])
+    if not flash_supported(q.shape[1], k.shape[1], q.shape[3], bq, bk):
+        raise ValueError(
+            f"flash_attention unsupported for shapes q={q.shape} "
+            f"k={k.shape} (blocks {bq}/{bk}); use ops.layers.attention")
+    qt = q.transpose(0, 2, 1, 3)   # [B,H,S,D]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash(qt, kt, vt, causal, q_offset, bq, bk, interpret)
+    return out.transpose(0, 2, 1, 3)
+
+
+def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                            mesh, causal: bool = True, q_offset: int = 0,
+                            head_axis: str = "tp",
+                            interpret: bool = False) -> jax.Array:
+    """Flash attention under GSPMD: a pallas_call is an opaque custom
+    call with no partitioning rule, so inside a sharded jit it must go
+    through shard_map — batch over the data axes, heads over tp, the
+    sequence unsharded per shard (use ring attention when sp > 1)."""
+    from jax.sharding import PartitionSpec as P
+
+    from tf_operator_tpu.parallel.mesh import data_axes
+
+    spec = P(data_axes(mesh), None,
+             head_axis if head_axis in mesh.axis_names else None, None)
+    fn = jax.shard_map(
+        functools.partial(flash_attention, causal=causal,
+                          q_offset=q_offset, interpret=interpret),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def best_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = True, q_offset: int = 0,
+                   mesh=None, force_flash: bool = False) -> jax.Array:
+    """Dispatch: pallas flash on TPU when shapes tile (through shard_map
+    when a mesh is active so GSPMD can partition it), else the XLA
+    reference. ``force_flash`` always takes the pallas path (interpret
+    mode off-TPU) — shape errors surface instead of falling back."""
+    from tf_operator_tpu.ops.layers import attention
+
+    sp_size = 1 if mesh is None else mesh.shape.get("sp", 1)
+    auto_ok = (on_tpu() and sp_size == 1
+               and flash_supported(q.shape[1], k.shape[1], q.shape[3]))
+    if force_flash or auto_ok:
+        interpret = not on_tpu()
+        if mesh is not None:
+            return flash_attention_sharded(q, k, v, mesh, causal=causal,
+                                           q_offset=q_offset,
+                                           interpret=interpret)
+        return flash_attention(q, k, v, causal=causal, q_offset=q_offset,
+                               interpret=interpret)
+    return attention(q, k, v, causal=causal, q_offset=q_offset)
