@@ -264,6 +264,25 @@ def test_all_workers_policy_waits_for_all():
     assert cond.is_succeeded(job.status)
 
 
+def test_all_replicas_ready_latch():
+    # The ready-latency latch fires only when EVERY desired replica is
+    # Running/Succeeded, not on the first active pod, and is set once.
+    job = testutil.new_tpujob(worker=2, chief=1)
+    pods = testutil.new_pod_list(job, "worker", 1, phase=PodPhase.RUNNING)
+    pods += testutil.new_pod_list(job, "chief", 1, phase=PodPhase.RUNNING)
+    run_status(job, pods)
+    assert job.status.all_replicas_ready_time is None  # worker-1 missing
+
+    pods = testutil.new_pod_list(job, "worker", 2, phase=PodPhase.RUNNING)
+    pods += testutil.new_pod_list(job, "chief", 1, phase=PodPhase.RUNNING)
+    run_status(job, pods)
+    first = job.status.all_replicas_ready_time
+    assert first is not None
+
+    run_status(job, pods)
+    assert job.status.all_replicas_ready_time == first  # latched
+
+
 def test_worker_failed_chiefless_sets_failed():
     job = testutil.new_tpujob(worker=2)
     pods = [testutil.new_pod(job, "worker", 0, phase=PodPhase.RUNNING),

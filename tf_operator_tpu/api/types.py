@@ -333,6 +333,10 @@ class JobStatus(ApiObject):
     start_time: Optional[_dt.datetime] = None
     completion_time: Optional[_dt.datetime] = None
     last_reconcile_time: Optional[_dt.datetime] = None
+    # TPU-native extension (no reference analog): when every desired
+    # replica first became Running/Succeeded — the latch behind the
+    # pod-to-AllReplicasReady latency metric (BASELINE north star).
+    all_replicas_ready_time: Optional[_dt.datetime] = None
 
 
 @dataclasses.dataclass

@@ -79,8 +79,13 @@ class Server:
     """Assembled operator process; separable from main() for tests."""
 
     def __init__(self, args: argparse.Namespace,
-                 store: Optional[store_mod.Store] = None):
+                 store: Optional[store_mod.Store] = None,
+                 on_fatal=None):
         self.args = args
+        # Called (from any thread) when the process must exit — main()
+        # wires this to its stop event so shutdown runs on the main
+        # thread, never on the elector's own thread.
+        self.on_fatal = on_fatal
         self.store = store or store_mod.Store()
         self.operator = Operator(
             store=self.store,
@@ -115,15 +120,26 @@ class Server:
 
     def _lost_lease(self) -> None:
         # The reference fatals on lost leadership (server.go:178-182): a
-        # stale leader must not keep writing. Same policy.
+        # stale leader must not keep writing. Same policy. Runs on the
+        # elector's thread: stop reconciling immediately, then hand the
+        # full shutdown to the main thread (shutdown() joins the elector
+        # thread, which must not join itself).
         log.error("leader lease lost; shutting down")
-        self.shutdown()
+        self._stop.set()
+        self.operator.stop()
+        if self.on_fatal is not None:
+            self.on_fatal()
+        else:
+            threading.Thread(target=self.shutdown, name="shutdown",
+                             daemon=True).start()
 
     def _resync_loop(self) -> None:
         """Level-triggered safety net: periodically re-enqueue every job
-        (reference: 15s ReconcilerSyncLoopPeriod via informer resync)."""
+        in the watched scope (reference: 15s ReconcilerSyncLoopPeriod via
+        informer resync)."""
         while not self._stop.wait(self.args.resync_period):
-            for job in self.store.list(store_mod.TPUJOBS):
+            for job in self.store.list(store_mod.TPUJOBS,
+                                       namespace=self.args.namespace or None):
                 self.operator.controller.enqueue(job.key())
 
     def start(self) -> None:
@@ -151,8 +167,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     setup_logging(json_format=args.json_log)
     log.info("%s starting", version_string())
 
-    server = Server(args)
     stop_event = threading.Event()
+    server = Server(args, on_fatal=stop_event.set)
     signal_count = [0]
 
     def _on_signal(signum, frame):

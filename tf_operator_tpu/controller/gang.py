@@ -98,15 +98,13 @@ class SliceGangScheduler(GangScheduler):
         self._admit()
 
     def delete_slice_group(self, job: TPUJob) -> None:
-        existing = self.store.try_get(store_mod.SLICEGROUPS,
-                                      job.metadata.namespace,
-                                      job.metadata.name)
-        if existing is None:
-            return
-        self.store.try_delete(store_mod.SLICEGROUPS, job.metadata.namespace,
-                              job.metadata.name)
-        metrics.slicegroups_deleted.inc(job_namespace=job.metadata.namespace)
-        self._admit()  # freed capacity may admit queued groups
+        # try_delete's return is the atomicity seam: under concurrent
+        # syncs only the worker whose delete landed counts/re-admits.
+        if self.store.try_delete(store_mod.SLICEGROUPS,
+                                 job.metadata.namespace, job.metadata.name):
+            metrics.slicegroups_deleted.inc(
+                job_namespace=job.metadata.namespace)
+            self._admit()  # freed capacity may admit queued groups
 
     def annotate_pod(self, job: TPUJob, pod: Pod, rtype: str) -> None:
         """Reference: schedulerName + group-name + task-spec annotations

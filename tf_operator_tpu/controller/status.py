@@ -83,6 +83,22 @@ def update_job_status(job: TPUJob, replica_specs: Dict[str, ReplicaSpec],
 
     has_chief = contains_chief_or_master(replica_specs)
 
+    # AllReplicasReady latency (BASELINE north star): observed once, when
+    # EVERY desired replica across all types is Running or already done —
+    # not on the first Running transition, which fires at one active pod.
+    if status.all_replicas_ready_time is None:
+        all_ready = all(
+            (status.replica_statuses.get(rt) is not None
+             and status.replica_statuses[rt].active
+             + status.replica_statuses[rt].succeeded >= (spec.replicas or 0))
+            for rt, spec in replica_specs.items())
+        if all_ready and job.metadata.creation_timestamp is not None:
+            status.all_replicas_ready_time = now
+            dt = (now - job.metadata.creation_timestamp).total_seconds()
+            if dt >= 0:
+                metrics.ready_latency_seconds.observe(
+                    dt, job_namespace=job.metadata.namespace)
+
     # Capture restart state BEFORE any Running condition is set below:
     # setting Running removes Restarting (mutual exclusion), and the
     # failed>0 guard must still see that a restart is in flight this sync.
@@ -138,19 +154,6 @@ def update_job_status(job: TPUJob, replica_specs: Dict[str, ReplicaSpec],
 
 def _set_running(job: TPUJob, recorder: Optional[Recorder]) -> None:
     msg = f"TPUJob {job.key()} is running."
-    first_run = (not cond.is_running(job.status)
-                 and cond.get_condition(job.status,
-                                        JobConditionType.RESTARTING) is None)
-    if first_run and job.metadata.creation_timestamp is not None:
-        # Creation-to-Running latency: the BASELINE pod-to-AllReplicasReady
-        # north star, observed on the FIRST Running transition only — a
-        # restart->Running re-transition carries a Restarting condition
-        # (Running/Restarting mutual exclusion) and is excluded.
-        dt = (_dt.datetime.now(_dt.timezone.utc)
-              - job.metadata.creation_timestamp).total_seconds()
-        if dt >= 0:
-            metrics.ready_latency_seconds.observe(
-                dt, job_namespace=job.metadata.namespace)
     cond.update_job_conditions(job.status, JobConditionType.RUNNING,
                                cond.JOB_RUNNING_REASON, msg)
 

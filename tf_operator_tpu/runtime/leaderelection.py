@@ -190,6 +190,8 @@ class LeaderElector:
         self._stop.set()
         self._leading.clear()
         is_leader_gauge.set(0)
-        if self._thread is not None:
+        # Callable from the elector's own thread (on_stopped_leading →
+        # shutdown paths); a thread cannot join itself.
+        if self._thread is not None and self._thread is not threading.current_thread():
             self._thread.join(timeout=5)
         self.release()
