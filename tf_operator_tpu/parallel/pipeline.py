@@ -1,0 +1,130 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+The reference has no pipeline parallelism anywhere (SURVEY §2.3 —
+TP/PP/SP/EP absent); in this framework it is a harness feature, built
+the TPU-idiomatic way: an explicit GPipe-style microbatch schedule
+inside ``shard_map``, with activations handed to the next stage by
+``ppermute`` (ICI neighbor transfers), not a port of any
+send/recv-thread design.
+
+How it maps to hardware:
+- each pp rank holds one *stage* (a contiguous chunk of layers whose
+  params carry a leading stage axis sharded over ``pp``);
+- one scan step = every stage computes its microbatch then ppermutes
+  the activation ring-forward; XLA overlaps the permute with the next
+  step's compute (async collective);
+- the schedule runs ``num_microbatches + pp - 1`` steps; the ``pp - 1``
+  bubble steps compute garbage that is masked out of the output. Bubble
+  fraction = (pp-1)/(m+pp-1): amortize with more microbatches;
+- everything is ``lax.scan`` + ``ppermute`` — differentiable, so the
+  backward pipeline schedule falls out of autodiff for free.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tf_operator_tpu.parallel.mesh import data_axes
+
+# stage_fn(stage_params, x) -> y, applied by every pp rank to its own
+# stage params. x/y must have identical shape/dtype (residual-stream
+# style), which is what makes the ring handoff well-typed.
+StageFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def split_microbatches(x: jax.Array, num_microbatches: int) -> jax.Array:
+    """[B, ...] -> [m, B/m, ...] (leading microbatch axis)."""
+    b = x.shape[0]
+    if b % num_microbatches != 0:
+        raise ValueError(
+            f"batch {b} not divisible by {num_microbatches} microbatches")
+    return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+
+def merge_microbatches(x: jax.Array) -> jax.Array:
+    """[m, B/m, ...] -> [B, ...]."""
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+
+def pipeline_apply(stage_fn: StageFn, stage_params: Any,
+                   microbatches: jax.Array,
+                   axis_name: str = "pp") -> jax.Array:
+    """GPipe schedule; call inside shard_map (stage_params = this rank's
+    stage, microbatches [m, mb, ...] identical on every pp rank).
+
+    Returns the full [m, mb, ...] outputs on every pp rank.
+    """
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    fwd_ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def step(carry, t):
+        state, outputs = carry
+        # Stage 0 feeds a fresh microbatch; later stages consume the
+        # activation ppermuted in by the previous step.
+        x_t = lax.dynamic_index_in_dim(microbatches, t % m, axis=0,
+                                       keepdims=False)
+        inp = jnp.where(stage == 0, x_t, state)
+        y = stage_fn(stage_params, inp)
+        # The last stage finishes microbatch t-(n_stages-1) at step t.
+        out_idx = t - (n_stages - 1)
+        write = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+        slot = jnp.maximum(out_idx, 0)
+        updated = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(write, y,
+                      lax.dynamic_index_in_dim(outputs, slot, axis=0,
+                                               keepdims=False)),
+            slot, axis=0)
+        state = lax.ppermute(y, axis_name, fwd_ring)
+        return (state, updated), None
+
+    state0 = jnp.zeros_like(microbatches[0])
+    out0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = lax.scan(step, (state0, out0),
+                               jnp.arange(m + n_stages - 1))
+    # Outputs are only valid on the last stage; replicate them across the
+    # ring so downstream (loss) code is rank-agnostic.
+    outputs = jnp.where(stage == n_stages - 1, outputs,
+                        jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis_name)
+
+
+def pipeline_sharded(stage_fn: StageFn, stacked_params: Any, x: jax.Array,
+                     mesh: Mesh, num_microbatches: int,
+                     axis_name: str = "pp") -> jax.Array:
+    """Global-view pipeline: ``stacked_params`` leaves carry a leading
+    [pp] stage axis (sharded over the pp mesh axis); ``x`` is the global
+    [B, ...] activation batch (B sharded over the data axes).
+
+    Splits x into microbatches, runs the GPipe schedule under shard_map,
+    and merges back to [B, ...].
+    """
+    batch_axes = data_axes(mesh)
+    pspec = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stacked_params)
+    xspec = P(None, batch_axes)   # [m, mb, ...]: mb sharded over data axes
+
+    def inner(params, mb):
+        # Inside shard_map the leading stage axis is size 1 on each rank.
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        return pipeline_apply(stage_fn, local, mb, axis_name=axis_name)
+
+    fn = jax.shard_map(inner, mesh=mesh, in_specs=(pspec, xspec),
+                       out_specs=xspec, check_vma=False)
+    return merge_microbatches(fn(stacked_params,
+                                 split_microbatches(x, num_microbatches)))
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading [pp]
+    axis on every leaf (the layout pipeline_sharded expects)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
