@@ -1,0 +1,61 @@
+"""Generate docs/api.md from the API dataclasses (the reference's
+generated docs/api/generated.asciidoc analog). Freshness enforced by
+tests/test_manifests.py."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tf_operator_tpu.api.schema import generate_schema  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "api.md")
+
+HEADER = """# TPUJob API reference
+
+*Generated from the API dataclasses by `docs/gen_api.py` — do not edit.*
+
+Wire format: camelCase JSON/YAML (K8s convention); machine-readable
+schema at `manifests/base/tpujob.schema.json`. Semantic rules beyond
+types (required containers, replica bounds, name formats) live in
+`tf_operator_tpu/api/validation.py`.
+"""
+
+
+def _fmt_type(prop: dict) -> str:
+    if "$ref" in prop:
+        name = prop["$ref"].rsplit("/", 1)[-1]
+        return f"[{name}](#{name.lower()})"
+    t = prop.get("type")
+    if t == "array":
+        return f"[]{_fmt_type(prop.get('items', {}))}"
+    if t == "object" and "additionalProperties" in prop:
+        return f"map[string]{_fmt_type(prop['additionalProperties'])}"
+    if t == "string" and prop.get("format") == "date-time":
+        return "string (RFC3339)"
+    return t or "any"
+
+
+def render() -> str:
+    schema = generate_schema()
+    lines = [HEADER]
+
+    def emit(name: str, obj: dict):
+        lines.append(f"\n## {name}\n")
+        lines.append("| Field | Type |")
+        lines.append("|---|---|")
+        for field, prop in obj.get("properties", {}).items():
+            lines.append(f"| `{field}` | {_fmt_type(prop)} |")
+
+    emit(schema["title"], schema)
+    for name, obj in schema.get("$defs", {}).items():
+        emit(name, obj)
+    return "\n".join(lines) + "\n"
+
+
+if __name__ == "__main__":
+    with open(OUT, "w") as f:
+        f.write(render())
+    print(f"wrote {OUT}")

@@ -1,0 +1,87 @@
+"""Distributed MNIST payload (reference examples/v1/dist-mnist analog).
+
+Each replica reads the operator-injected bootstrap env
+(`TPUJOB_CLUSTER_SPEC`, `TPU_WORKER_ID`, `JAX_COORDINATOR_ADDRESS`) and
+trains the in-repo MNIST model on synthetic data with the framework
+trainer. Multi-process jax.distributed bring-up happens only when the
+cluster spec says there is more than one process; a single replica (or
+standalone invocation) trains locally.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def maybe_init_distributed() -> int:
+    """Returns this process's rank (0 when not distributed).
+
+    Multi-process bring-up is opt-in (TPUJOB_JAX_DISTRIBUTED=1): on TPU
+    pods each replica joins the coordination service and jax.devices()
+    becomes the global slice; without it each replica trains on its
+    local devices (the reference dist-mnist's between-graph style)."""
+    num = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    pid = int(os.environ.get("JAX_PROCESS_ID", os.environ.get(
+        "TPU_WORKER_ID", "0")))
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+    if (num > 1 and coord
+            and os.environ.get("TPUJOB_JAX_DISTRIBUTED") == "1"):
+        import jax
+
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=num, process_id=pid)
+    return pid
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    spec = os.environ.get("TPUJOB_CLUSTER_SPEC")
+    if spec:
+        task = json.loads(spec).get("task", {})
+        print(f"replica {task.get('type')}-{task.get('index')} starting")
+    rank = maybe_init_distributed()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tf_operator_tpu.models.mnist import MnistCNN, synthetic_batch
+    from tf_operator_tpu.models.resnet import param_logical_axes
+    from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh
+    from tf_operator_tpu.parallel.sharding import CNN_RULES
+    from tf_operator_tpu.train.trainer import Trainer, classification_loss
+
+    mesh = make_mesh(MeshConfig(dp=-1))
+    trainer = Trainer(model=MnistCNN(), param_axes_fn=param_logical_axes,
+                      rules=CNN_RULES, mesh=mesh,
+                      optimizer=optax.adam(1e-3),
+                      loss_fn=classification_loss)
+    rng = jax.random.PRNGKey(0)
+    batch = {k: jnp.asarray(v) for k, v in
+             synthetic_batch(rng, batch_size=args.batch_size).items()}
+    state, shardings = trainer.init(rng, batch)
+    step = trainer.make_train_step(shardings, batch)
+
+    first = last = None
+    for i in range(args.steps):
+        batch = {k: jnp.asarray(v) for k, v in synthetic_batch(
+            jax.random.PRNGKey(i + 1), batch_size=args.batch_size).items()}
+        state, metrics = step(state, batch)
+        loss = float(metrics["loss"])
+        first = loss if first is None else first
+        last = loss
+        if rank == 0 and (i % 5 == 0 or i == args.steps - 1):
+            print(f"step {i}: loss={loss:.4f}")
+    print(f"done: loss {first:.4f} -> {last:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+"""Flagship SPMD decoder training (the BASELINE Llama config family).
+
+No reference analog — the reference delegates training to user
+containers; here the harness is in-repo. Builds a dp/fsdp/tp mesh over
+the visible devices, shards the model by the logical-axis rule table,
+and trains on synthetic token data. `--size tiny` (default) runs
+anywhere; `--size 8b` is the real v5p-slice config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--size", choices=["tiny", "8b"], default="tiny")
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--fsdp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tf_operator_tpu.models.llama import (
+        Llama,
+        llama_3_8b,
+        llama_tiny,
+        param_logical_axes,
+    )
+    from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh, use_mesh
+    from tf_operator_tpu.parallel.sharding import LLAMA_RULES
+    from tf_operator_tpu.train.trainer import Trainer
+
+    if args.size == "8b":
+        cfg = llama_3_8b()
+    else:
+        cfg = llama_tiny(vocab_size=512, max_seq_len=args.seq_len * 2)
+
+    mesh = make_mesh(MeshConfig(dp=-1, fsdp=args.fsdp, tp=args.tp))
+    print("mesh:", dict(mesh.shape))
+    trainer = Trainer(model=Llama(cfg), param_axes_fn=param_logical_axes,
+                      rules=LLAMA_RULES, mesh=mesh,
+                      optimizer=optax.adamw(3e-4))
+    rng = jax.random.PRNGKey(0)
+    sample = {"inputs": jnp.zeros((args.batch_size, args.seq_len + 1),
+                                  jnp.int32)}
+    with use_mesh(mesh):
+        state, shardings = trainer.init(rng, sample)
+        step = trainer.make_train_step(shardings, sample)
+        data_rng = np.random.default_rng(0)
+        for i in range(args.steps):
+            tokens = jnp.asarray(data_rng.integers(
+                0, cfg.vocab_size, (args.batch_size, args.seq_len + 1)),
+                jnp.int32)
+            state, metrics = step(state, {"inputs": tokens})
+            print(f"step {i}: loss={float(metrics['loss']):.4f}")
+    print("llama training OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

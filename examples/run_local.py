@@ -1,0 +1,68 @@
+"""Apply a TPUJob YAML against the hermetic local runtime.
+
+The `kubectl apply -f` + `kubectl logs` analog (reference SDK
+`TFJobClient.create`/`get_logs`, sdk/.../tf_job_client.py:77,380):
+starts an in-process operator with the subprocess pod backend, submits
+the job, waits for Succeeded/Failed, and prints each replica's log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import yaml
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tf_operator_tpu.api.types import JobConditionType, TPUJob  # noqa: E402
+from tf_operator_tpu.operator import Operator  # noqa: E402
+from tf_operator_tpu.runtime.local import LocalProcessBackend  # noqa: E402
+from tf_operator_tpu.sdk.client import TPUJobClient  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("spec", help="TPUJob YAML/JSON file")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    args = ap.parse_args()
+
+    with open(args.spec) as f:
+        job = TPUJob.from_dict(yaml.safe_load(f))
+
+    backend = LocalProcessBackend(
+        store=None, workdir=REPO_ROOT,
+        extra_env={"PYTHONPATH": REPO_ROOT + os.pathsep
+                   + os.environ.get("PYTHONPATH", "")})
+    op = Operator(backend=backend)
+    backend.store = op.store
+    op.start(threadiness=2)
+    client = TPUJobClient(op.store)
+    try:
+        client.create(job)
+        name = job.metadata.name
+        print(f"submitted TPUJob {name}; waiting (timeout {args.timeout}s)")
+        try:
+            done = client.wait_for_job(name, timeout=args.timeout)
+            state = "Succeeded" if any(
+                c.type == JobConditionType.SUCCEEDED and c.status == "True"
+                for c in done.status.conditions) else "Failed"
+        except TimeoutError:
+            # Still print the diagnostics the script exists to show.
+            done = client.get(name)
+            state = "TimedOut"
+        print(f"TPUJob {name}: {state}")
+        for cond in done.status.conditions:
+            print(f"  condition {cond.type}={cond.status} ({cond.reason})")
+        for pod_name in client.get_pod_names(name):
+            print(f"--- logs {pod_name} ---")
+            print(client.get_logs(pod_name) or "(no output)")
+        return 0 if state == "Succeeded" else 1
+    finally:
+        op.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""Smoke payload: a matmul on every visible device.
+
+Reference analog: examples/tf_sample/tf_smoke.py (all-device matmul).
+Prints the bootstrap env the operator injected, runs one jitted matmul
+per device, and exits 0 on success.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    bootstrap = {k: v for k, v in sorted(os.environ.items())
+                 if k.startswith(("TPU_", "JAX_", "TPUJOB_", "MEGASCALE_"))}
+    print("bootstrap env:", json.dumps(bootstrap, indent=1))
+
+    import jax
+    import jax.numpy as jnp
+
+    for device in jax.local_devices():
+        x = jax.device_put(jnp.ones((256, 256), jnp.bfloat16), device)
+        y = jax.jit(lambda a: (a @ a).sum(), device=device)(x)
+        print(f"{device}: matmul sum = {float(y):.1f}")
+    print("smoke OK on", len(jax.local_devices()), "device(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,20 @@
+"""Regenerate checked-in generated manifests (hack/update-codegen.sh
+analog). tests/test_manifests.py is the verify-codegen analog: it fails
+when the checked-in schema drifts from the API dataclasses."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tf_operator_tpu.api.schema import generate_schema  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "base", "tpujob.schema.json")
+
+if __name__ == "__main__":
+    with open(OUT, "w") as f:
+        json.dump(generate_schema(), f, indent=2, sort_keys=False)
+        f.write("\n")
+    print(f"wrote {OUT}")

@@ -145,6 +145,28 @@ def test_pod_names_contract(operator, client, tmp_path):
     client.wait_for_job("names", timeout=15)
 
 
+def test_pod_logs_captured(operator, client, tmp_path):
+    """get_logs parity: stdout of each replica is retrievable through
+    the SDK (reference tf_job_client get_logs, sdk :380-446)."""
+    stub_dir = str(tmp_path / "stub")
+    job = stub_job("logs", stub_dir, worker=2,
+                   args=("--exit-after", "0.3"))
+    # Retain every pod at completion: under the default cleanPodPolicy
+    # (Running) a still-running sibling is deleted when worker-0's exit
+    # ends the job, and log retention follows the pod object.
+    job.spec.run_policy.clean_pod_policy = "None"
+    client.create(job)
+    client.wait_for_job("logs", timeout=15)
+    def banners_present():
+        logs = client.get_job_logs("logs")
+        return (sorted(logs) == ["logs-worker-0", "logs-worker-1"]
+                and all(f"worker stub {name} started" in text
+                        for name, text in logs.items()))
+    wait_for(banners_present, message="all pod log banners")
+    assert client.get_logs("logs-worker-0", tail_lines=1).count("\n") == 0
+    assert client.get_logs("logs-worker-0", tail_lines=0) == ""
+
+
 def test_restart_policy_exit_code_retryable(operator, client, tmp_path):
     """replica_restart_policy_tests analog: retryable exit -> same-identity
     restart (new pod uid, same name), then clean completion."""

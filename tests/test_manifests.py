@@ -1,0 +1,78 @@
+"""Manifests and examples stay consistent with the API surface.
+
+- schema codegen-verify (hack/verify-codegen.sh analog);
+- every example TPUJob YAML parses, validates (schema + semantic
+  validation), and round-trips through the wire format.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import jsonschema
+import pytest
+import yaml
+
+from tf_operator_tpu import testutil
+from tf_operator_tpu.api import set_defaults
+from tf_operator_tpu.api.schema import generate_schema
+from tf_operator_tpu.api.types import TPUJob
+from tf_operator_tpu.api.validation import validate_job
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCHEMA_PATH = os.path.join(REPO, "manifests", "base", "tpujob.schema.json")
+EXAMPLE_SPECS = sorted(glob.glob(os.path.join(REPO, "examples", "*",
+                                              "tpujob_*.yaml")))
+
+
+def test_checked_in_schema_matches_generated():
+    with open(SCHEMA_PATH) as f:
+        checked_in = json.load(f)
+    assert checked_in == generate_schema(), (
+        "manifests/base/tpujob.schema.json is stale; run "
+        "python manifests/gen.py")
+
+
+def test_generated_api_doc_fresh():
+    sys.path.insert(0, os.path.join(REPO, "docs"))
+    import gen_api
+
+    with open(os.path.join(REPO, "docs", "api.md")) as f:
+        assert f.read() == gen_api.render(), (
+            "docs/api.md is stale; run python docs/gen_api.py")
+
+
+def test_schema_accepts_real_jobs():
+    schema = generate_schema()
+    job = testutil.new_tpujob(worker=4, ps=2, chief=1)
+    jsonschema.validate(job.to_dict(), schema)
+
+
+def test_schema_rejects_malformed():
+    schema = generate_schema()
+    for bad in (
+        {"spec": {"replicaSpecs": "not-a-map"}},
+        {"spec": {"runPolicy": {"backoffLimit": "three"}}},
+        {"metadata": {"name": 42}},
+        {"unknownTopLevel": {}},
+    ):
+        with pytest.raises(jsonschema.ValidationError):
+            jsonschema.validate(bad, schema)
+
+
+def test_examples_exist():
+    assert len(EXAMPLE_SPECS) >= 3
+
+
+@pytest.mark.parametrize("path", EXAMPLE_SPECS,
+                         ids=[os.path.basename(p) for p in EXAMPLE_SPECS])
+def test_example_spec_valid(path):
+    with open(path) as f:
+        data = yaml.safe_load(f)
+    jsonschema.validate(data, generate_schema())
+    job = TPUJob.from_dict(data)
+    set_defaults(job)
+    validate_job(job)
+    # wire round-trip is lossless
+    assert TPUJob.from_dict(job.to_dict()).to_dict() == job.to_dict()

@@ -1,0 +1,86 @@
+"""JSON-Schema generation for the TPUJob API surface.
+
+The reference ships a generated OpenAPI schema (openapi_generated.go,
+13.5k lines) that backs CRD validation (manifests/base/crd.yaml
+openAPIV3Schema) and SDK model generation. Here the schema is derived
+reflectively from the same dataclasses that define the wire format
+(api/types.py + serde.py), so it can never drift from the code — and a
+checked-in copy under manifests/ is kept honest by a codegen-verify
+test (the hack/verify-codegen.sh analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+from typing import Any, Dict, get_args, get_origin
+
+from tf_operator_tpu.api.serde import (
+    ApiObject,
+    _hints_for,
+    _unwrap_optional,
+    snake_to_camel,
+)
+
+_PRIMITIVES = {
+    str: {"type": "string"},
+    int: {"type": "integer"},
+    float: {"type": "number"},
+    bool: {"type": "boolean"},
+}
+
+
+def _type_schema(tp: Any, defs: Dict[str, dict]) -> dict:
+    tp = _unwrap_optional(tp)
+    if tp in _PRIMITIVES:
+        return dict(_PRIMITIVES[tp])
+    if tp is _dt.datetime:
+        return {"type": "string", "format": "date-time"}
+    if tp is Any or tp is object:
+        return {}
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        args = get_args(tp)
+        item = _type_schema(args[0], defs) if args else {}
+        return {"type": "array", "items": item}
+    if origin is dict:
+        args = get_args(tp)
+        val = _type_schema(args[1], defs) if len(args) == 2 else {}
+        return {"type": "object", "additionalProperties": val}
+    if isinstance(tp, type) and issubclass(tp, ApiObject):
+        name = tp.__name__
+        if name not in defs:
+            defs[name] = {}  # placeholder breaks recursion cycles
+            defs[name] = _object_schema(tp, defs)
+        return {"$ref": f"#/$defs/{name}"}
+    return {}  # unknown: accept anything (parity with unvalidated fields)
+
+
+def _object_schema(cls, defs: Dict[str, dict]) -> dict:
+    props = {}
+    for f in dataclasses.fields(cls):
+        hint = _hints_for(cls).get(f.name, Any)
+        props[snake_to_camel(f.name)] = _type_schema(hint, defs)
+    return {
+        "type": "object",
+        "properties": props,
+        "additionalProperties": False,
+    }
+
+
+def generate_schema(cls=None) -> dict:
+    """JSON Schema (draft 2020-12) for ``cls`` (default: TPUJob)."""
+    if cls is None:
+        from tf_operator_tpu.api.types import TPUJob
+        cls = TPUJob
+    defs: Dict[str, dict] = {}
+    root = _object_schema(cls, defs)
+    schema = {
+        "$schema": "https://json-schema.org/draft/2020-12/schema",
+        "$id": f"https://tpu-operator.dev/schemas/{cls.__name__}.json",
+        "title": cls.__name__,
+        **root,
+    }
+    if defs:
+        schema["$defs"] = dict(sorted(defs.items()))
+    return schema

@@ -201,6 +201,9 @@ class PodStatus(ApiObject):
     start_time: Optional[_dt.datetime] = None
     host: str = ""
     message: str = ""
+    # Where the runtime captured this pod's combined stdout/stderr (the
+    # kubelet-log analog the SDK's get_logs reads).
+    log_path: str = ""
 
     def container_status(self, name: str) -> Optional[ContainerStatus]:
         for cs in self.container_statuses:

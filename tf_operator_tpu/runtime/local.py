@@ -22,6 +22,7 @@ import os
 import signal
 import socket
 import subprocess
+import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
@@ -59,10 +60,15 @@ class _RunningPod:
 
 class LocalProcessBackend:
     def __init__(self, store: Store, workdir: Optional[str] = None,
-                 extra_env: Optional[Dict[str, str]] = None):
+                 extra_env: Optional[Dict[str, str]] = None,
+                 log_dir: Optional[str] = None):
         self.store = store
         self.workdir = workdir or os.getcwd()
         self.extra_env = dict(extra_env or {})
+        # Pod stdout/stderr capture (kubelet container-log analog);
+        # surfaced to clients via pod.status.log_path.
+        self.log_dir = log_dir or os.path.join(
+            tempfile.gettempdir(), f"tpujob-logs-{os.getpid()}")
         self._lock = threading.Lock()
         self._running: Dict[str, _RunningPod] = {}  # "ns/name" -> state
         self._job_ports: Dict[str, int] = {}        # job uid -> coord port
@@ -103,6 +109,11 @@ class LocalProcessBackend:
                 # dispatcher thread free.
                 threading.Thread(target=self._terminate, args=(rp,),
                                  daemon=True).start()
+            # Log retention follows the pod object (kubelet semantics).
+            try:
+                os.unlink(self.pod_log_path(pod))
+            except OSError:
+                pass
 
     # ------------------------------------------------------------------
 
@@ -159,15 +170,26 @@ class LocalProcessBackend:
         env.update(self._localize_env(pod, container.env))
         env["TPUJOB_POD_NAME"] = pod.metadata.name
         env["TPUJOB_POD_NAMESPACE"] = pod.metadata.namespace
-        proc = subprocess.Popen(
-            argv,
-            cwd=container.working_dir or self.workdir,
-            env=env,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-            start_new_session=True,
-        )
+        os.makedirs(self.log_dir, exist_ok=True)
+        log_path = self.pod_log_path(pod)
+        with open(log_path, "ab") as log_file:
+            proc = subprocess.Popen(
+                argv,
+                cwd=container.working_dir or self.workdir,
+                env=env,
+                stdout=log_file,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
         rp.processes[container_name] = proc
+
+    def pod_log_path(self, pod: Pod) -> str:
+        # Keyed by uid so a restart-with-identity (same name, new pod)
+        # gets a fresh file, not the dead incarnation's output.
+        uid = (pod.metadata.uid or "nouid")[:8]
+        return os.path.join(
+            self.log_dir,
+            f"{pod.metadata.namespace}.{pod.metadata.name}.{uid}.log")
 
     def _localize_env(self, pod: Pod, env: Dict[str, str]) -> Dict[str, str]:
         """Rewrite cluster DNS names to 127.0.0.1 for single-host runs."""
@@ -286,6 +308,9 @@ class LocalProcessBackend:
                                     pod.metadata.name)
         if stored is None:
             return  # deleted concurrently
+        log_path = self.pod_log_path(pod)
+        if os.path.exists(log_path):
+            status.log_path = log_path
         stored.status = status
         try:
             self.store.update_status(store_mod.PODS, stored)

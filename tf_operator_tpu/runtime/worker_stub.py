@@ -49,6 +49,9 @@ def main(argv=None) -> int:
 
     stub_dir = os.environ.get("TPUJOB_STUB_DIR", "")
     pod_name = os.environ.get("TPUJOB_POD_NAME", f"pid-{os.getpid()}")
+    # Identity banner on stdout: exercised by the log-capture path
+    # (reference test-server logs requests the same way).
+    print(f"worker stub {pod_name} started", flush=True)
 
     cmd_path = None
     if stub_dir:

@@ -142,3 +142,33 @@ class TPUJobClient:
             store_mod.PODS, namespace=namespace or self.namespace,
             selector={constants.LABEL_GROUP_NAME: constants.GROUP,
                       constants.LABEL_JOB_NAME: name})
+
+    def get_logs(self, pod_name: str, namespace: Optional[str] = None,
+                 tail_lines: Optional[int] = None) -> str:
+        """One pod's captured stdout/stderr (reference
+        tf_job_client.py:380-446 read_namespaced_pod_log analog)."""
+        pod = self.store.try_get(store_mod.PODS,
+                                 namespace or self.namespace, pod_name)
+        if pod is None or not pod.status.log_path:
+            return ""
+        try:
+            with open(pod.status.log_path, errors="replace") as f:
+                text = f.read()
+        except OSError:
+            return ""
+        if tail_lines is not None:
+            lines = text.splitlines()[-tail_lines:] if tail_lines > 0 else []
+            text = "\n".join(lines)
+        return text
+
+    def get_job_logs(self, name: str, namespace: Optional[str] = None,
+                     replica_type: Optional[str] = None,
+                     tail_lines: Optional[int] = None) -> Dict[str, str]:
+        """Logs for every pod of a job, keyed by pod name (the
+        reference's multi-pod get_logs surface)."""
+        return {
+            pod_name: self.get_logs(pod_name, namespace=namespace,
+                                    tail_lines=tail_lines)
+            for pod_name in self.get_pod_names(
+                name, namespace=namespace, replica_type=replica_type)
+        }
