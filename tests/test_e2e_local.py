@@ -10,6 +10,7 @@ subprocess pods and the file-based worker stub.
 import json
 import os
 import sys
+import threading
 import time
 
 import pytest
@@ -264,6 +265,118 @@ def test_job_deletion_cascades_to_pods(operator, client, tmp_path):
     wait_for(lambda: client.get_pod_names("reap") == [],
              message="owned pods garbage-collected")
     assert operator.store.list(store_mod.ENDPOINTS) == []
+
+
+def test_sdk_watch_streams_job_events(operator, client, tmp_path):
+    """TFJobWatch analog: the watch generator streams the job's
+    lifecycle and terminates on the terminal condition."""
+    stub_dir = str(tmp_path / "stub")
+    client.create(stub_job("watched", stub_dir, worker=1,
+                           args=("--exit-after", "0.3")))
+    seen = [job for _, job in client.watch("watched", timeout=15,
+                                           until_finished=True)]
+    assert seen, "watch yielded no events"
+    assert testutil.check_condition(seen[-1], JobConditionType.SUCCEEDED)
+    # lifecycle progressed: some earlier event lacked the terminal state
+    assert any(not testutil.check_condition(j, JobConditionType.SUCCEEDED)
+               for j in seen[:-1]) or len(seen) == 1
+
+
+def test_sdk_watch_terminates_on_deletion(operator, client, tmp_path):
+    """A watched job deleted mid-run is terminal for until_finished —
+    no further events will ever arrive for it."""
+    stub_dir = str(tmp_path / "stub")
+    client.create(stub_job("shortlived", stub_dir, worker=1,
+                           args=("--exit-after", "30")))
+    client.wait_for_condition("shortlived", JobConditionType.RUNNING,
+                              timeout=10)
+
+    def delete_soon():
+        time.sleep(0.3)
+        client.delete("shortlived")
+
+    t = threading.Thread(target=delete_soon)
+    t.start()
+    events = list(client.watch("shortlived", timeout=10,
+                               until_finished=True))
+    t.join()
+    assert events and events[-1][0] == store_mod.DELETED
+
+
+def test_runconfig_golden_full_topology(operator, client, tmp_path):
+    """estimator_runconfig_tests analog: every replica's effective
+    bootstrap config (cluster spec, task identity, rank, coordinator)
+    matches expectations built from the naming contract."""
+    stub_dir = str(tmp_path / "stub")
+    job = stub_job("golden", stub_dir, worker=2, chief=1,
+                   args=("--exit-after", "0.5"))
+    job.spec.run_policy.clean_pod_policy = "None"
+    client.create(job)
+    client.wait_for_job("golden", timeout=15)
+
+    def snap(rtype, idx):
+        with open(os.path.join(stub_dir,
+                               f"golden-{rtype}-{idx}.env.json")) as f:
+            return json.load(f)
+
+    expected_hosts = {
+        "chief": ["golden-chief-0.default.svc"],
+        "worker": ["golden-worker-0.default.svc",
+                   "golden-worker-1.default.svc"],
+    }
+    # chief is process 0; workers follow (bootstrap/cluster.py ranks)
+    expected_rank = {("chief", 0): 0, ("worker", 0): 1, ("worker", 1): 2}
+    for (rtype, idx), rank in expected_rank.items():
+        env = snap(rtype, idx)
+        spec = json.loads(env["TPUJOB_CLUSTER_SPEC"])
+        assert spec["task"] == {"type": rtype, "index": idx}
+        got_hosts = {t: [h.rsplit(":", 1)[0] for h in hosts]
+                     for t, hosts in spec["cluster"].items()}
+        assert got_hosts == expected_hosts
+        assert env["JAX_PROCESS_ID"] == str(rank)
+        assert env["JAX_NUM_PROCESSES"] == "3"
+        # coordinator is the chief's replica-0 DNS name for every replica
+        assert env["JAX_COORDINATOR_ADDRESS"].split(":")[0].startswith(
+            "127.0.0.1")  # localized by the process backend
+
+
+def test_cleanpod_policy_all_removes_pods_e2e(operator, client, tmp_path):
+    """cleanpod_policy_tests analog (All): completion deletes every pod
+    and endpoint."""
+    stub_dir = str(tmp_path / "stub")
+    job = stub_job("cleanall", stub_dir, worker=2,
+                   args=("--exit-after", "0.3"))
+    job.spec.run_policy.clean_pod_policy = "All"
+    client.create(job)
+    client.wait_for_job("cleanall", timeout=15)
+    wait_for(lambda: client.get_pod_names("cleanall") == [],
+             message="pods cleaned")
+    wait_for(lambda: not [
+        e for e in operator.store.list(store_mod.ENDPOINTS)
+        if e.metadata.name.startswith("cleanall-")],
+        message="endpoints cleaned")
+
+
+def test_elastic_worker_sparse_cluster_spec_e2e(operator, client, tmp_path):
+    """Dynamic-worker analog (enableDynamicWorker sparse TF_CONFIG,
+    reference tensorflow.go:64-83): each elastic worker sees only
+    itself in the cluster view."""
+    stub_dir = str(tmp_path / "stub")
+    job = stub_job("elastic", stub_dir, worker=2,
+                   args=("--exit-after", "0.5"))
+    job.spec.enable_elastic_worker = True
+    job.spec.run_policy.clean_pod_policy = "None"
+    client.create(job)
+    client.wait_for_job("elastic", timeout=15)
+    for idx in (0, 1):
+        with open(os.path.join(stub_dir,
+                               f"elastic-worker-{idx}.env.json")) as f:
+            env = json.load(f)
+        spec = json.loads(env["TPUJOB_CLUSTER_SPEC"])
+        workers = spec["cluster"]["worker"]
+        assert len(workers) == 1, workers  # sparse: only itself
+        assert f"elastic-worker-{idx}." in workers[0]
+        assert spec["task"] == {"type": "worker", "index": idx}
 
 
 def test_gang_scheduling_capacity_gate(tmp_path):

@@ -85,6 +85,18 @@ def test_watch_delivers_events_and_replay():
     assert events[2][0] == store_mod.DELETED
 
 
+def test_watcher_stop_deregisters_from_store():
+    s = Store()
+    w = s.watch(store_mod.TPUJOBS, lambda *_: None)
+    assert w in s._watchers
+    w.stop()
+    assert w not in s._watchers
+    # events after stop are not enqueued into the dead watcher
+    s.create(store_mod.TPUJOBS, testutil.new_tpujob(worker=1))
+    assert w.queue.qsize() <= 1  # only the stop sentinel (if undrained)
+    w.stop()  # idempotent
+
+
 def test_mutating_returned_object_does_not_affect_store():
     s = Store()
     created = s.create(store_mod.TPUJOBS, testutil.new_tpujob(worker=1))

@@ -67,6 +67,11 @@ class Watcher:
                     "watch handler error for %s", self.kind)
 
     def stop(self) -> None:
+        # Deregister from the store first so _notify stops enqueueing
+        # into a dead queue (unbounded growth otherwise).
+        on_stop = getattr(self, "_on_stop", None)
+        if on_stop is not None:
+            on_stop(self)
         self.queue.put(None)
 
 
@@ -186,11 +191,19 @@ class Store:
         delivered as ADDED first (informer initial list)."""
         with self._lock:
             w = Watcher(kind, handler)
+            w._on_stop = self._remove_watcher
             if replay:
                 for obj in self._objects.get(kind, {}).values():
                     w.queue.put((ADDED, obj.deepcopy()))
             self._watchers.append(w)
             return w
+
+    def _remove_watcher(self, w: Watcher) -> None:
+        with self._lock:
+            try:
+                self._watchers.remove(w)
+            except ValueError:
+                pass  # already removed (stop_watchers or double stop)
 
     def stop_watchers(self) -> None:
         with self._lock:

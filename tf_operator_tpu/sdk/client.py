@@ -93,6 +93,44 @@ class TPUJobClient:
             time.sleep(0.05)
         raise TimeoutError_(f"timed out waiting for {name} to finish")
 
+    def watch(self, name: Optional[str] = None,
+              namespace: Optional[str] = None,
+              timeout: Optional[float] = None,
+              until_finished: bool = False):
+        """Generator of ``(event_type, TPUJob)`` — the reference
+        TFJobWatch analog (sdk api/tf_job_watch.py). Existing jobs are
+        replayed as ADDED, then live events stream until ``timeout``
+        elapses, the generator is closed, or (with ``until_finished``)
+        the named job reaches a terminal condition."""
+        import queue as _queue
+
+        ns = namespace or self.namespace
+        q: "_queue.Queue" = _queue.Queue()
+        watcher = self.store.watch(
+            store_mod.TPUJOBS, lambda et, obj: q.put((et, obj)))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            while True:
+                remaining = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                try:
+                    event_type, job = q.get(timeout=remaining)
+                except _queue.Empty:
+                    return
+                if job.metadata.namespace != ns:
+                    continue
+                if name is not None and job.metadata.name != name:
+                    continue
+                yield event_type, job
+                if (until_finished and name is not None
+                        and (cond.is_finished(job.status)
+                             or event_type == store_mod.DELETED)):
+                    # DELETED is terminal too: no further events for
+                    # this job will ever arrive.
+                    return
+        finally:
+            watcher.stop()
+
     def wait_for_delete(self, name: str, timeout: float = 60.0,
                         namespace: Optional[str] = None) -> None:
         deadline = time.monotonic() + timeout
