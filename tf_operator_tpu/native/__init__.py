@@ -19,41 +19,48 @@ import numpy as np
 log = logging.getLogger("tpu_operator.native")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_DIR, "libbatchgen.so")
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
+_loaded: dict = {}  # lib filename -> CDLL | None (None = tried, failed)
 
 
-def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _tried
+def load_library(lib_name: str) -> Optional[ctypes.CDLL]:
+    """Build (make -C, once) and dlopen a native library from this
+    directory; None when the toolchain or library is unavailable.
+    Shared by every native binding module."""
     with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        if not os.path.exists(_LIB_PATH):
+        if lib_name in _loaded:
+            return _loaded[lib_name]
+        _loaded[lib_name] = None  # one attempt per process
+        path = os.path.join(_DIR, lib_name)
+        if not os.path.exists(path):
             try:
                 subprocess.run(["make", "-C", _DIR], check=True,
                                capture_output=True, timeout=120)
             except Exception as e:
-                log.info("native batchgen unavailable (%s); using numpy", e)
+                log.info("native build unavailable (%s); using fallback", e)
                 return None
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
+            _loaded[lib_name] = ctypes.CDLL(path)
         except OSError as e:
-            log.info("failed to load %s (%s); using numpy", _LIB_PATH, e)
-            return None
-        lib.tpuop_fill_uniform_f32.argtypes = [
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_uint64]
-        lib.tpuop_fill_randint_i32.argtypes = [
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
-            ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64]
-        lib.tpuop_normalize_u8_f32.argtypes = [
-            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
-            ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int32]
-        _lib = lib
-        return _lib
+            log.info("failed to load %s (%s); using fallback", path, e)
+        return _loaded[lib_name]
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    lib = load_library("libbatchgen.so")
+    if lib is None or hasattr(lib, "_tpuop_configured"):
+        return lib
+    lib._tpuop_configured = True
+    lib.tpuop_fill_uniform_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_uint64]
+    lib.tpuop_fill_randint_i32.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64]
+    lib.tpuop_normalize_u8_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int32]
+    return lib
 
 
 def available() -> bool:

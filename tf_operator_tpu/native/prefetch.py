@@ -1,0 +1,136 @@
+"""ctypes bindings for the native prefetching batch loader (libloader.so).
+
+Producer threads in C++ synthesize batches into a ring ahead of the
+consumer, overlapping input generation with the training step entirely
+outside the GIL. Returns None from ``create_*`` when the toolchain or
+library is unavailable — callers (train/data.py) fall back to the
+Python generators.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from tf_operator_tpu.native import load_library
+
+KIND_IMAGES = 0
+KIND_TOKENS = 1
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    lib = load_library("libloader.so")
+    if lib is None or hasattr(lib, "_tpuop_configured"):
+        return lib
+    lib._tpuop_configured = True
+    lib.tpuop_loader_create.restype = ctypes.c_void_p
+    lib.tpuop_loader_create.argtypes = [
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64]
+    lib.tpuop_loader_next.restype = ctypes.c_int64
+    lib.tpuop_loader_next.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.tpuop_loader_produced.restype = ctypes.c_int64
+    lib.tpuop_loader_produced.argtypes = [ctypes.c_void_p]
+    lib.tpuop_loader_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeLoader:
+    """Iterator of prefetched batches; call ``close()`` (or use as a
+    context manager) to stop the producer threads."""
+
+    def __init__(self, kind: int, dims, cardinality: int,
+                 depth: int = 4, threads: int = 2, seed: int = 0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native loader library unavailable")
+        self._lib = lib
+        self.kind = kind
+        self.dims = tuple(int(d) for d in dims)
+        c_dims = (ctypes.c_int64 * 4)(*(list(self.dims) + [0] * 4)[:4])
+        self._handle = lib.tpuop_loader_create(
+            kind, c_dims, cardinality, depth, threads,
+            ctypes.c_uint64(seed))
+        self._closed = False
+        # Serializes next/close so the handle is never used after free
+        # (close from another thread waits out an in-flight next, which
+        # is bounded: producers run until destroy).
+        self._call_lock = threading.Lock()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        # Dropped without close(): stop the producer threads rather than
+        # leaking them (and the ring buffers) for the process lifetime.
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        if getattr(self, "_closed", True):
+            return
+        with self._call_lock:
+            if not self._closed and self._handle:
+                self._lib.tpuop_loader_destroy(self._handle)
+                self._closed = True
+
+    def produced(self) -> int:
+        return int(self._lib.tpuop_loader_produced(self._handle))
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        with self._call_lock:
+            if self._closed:
+                raise StopIteration
+            if self.kind == KIND_IMAGES:
+                b, h, w, c = self.dims
+                main = np.empty((b, h, w, c), np.float32)
+                aux = np.empty((b,), np.int32)
+                idx = self._lib.tpuop_loader_next(
+                    self._handle, main.ctypes.data_as(ctypes.c_void_p),
+                    aux.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+                if idx < 0:
+                    raise StopIteration
+                return {"inputs": main, "labels": aux}
+            b, s = self.dims[:2]
+            main = np.empty((b, s), np.int32)
+            idx = self._lib.tpuop_loader_next(
+                self._handle, main.ctypes.data_as(ctypes.c_void_p), None)
+            if idx < 0:
+                raise StopIteration
+            return {"inputs": main}
+
+
+def create_images(batch_size: int, image_size: int = 224,
+                  num_classes: int = 1000, depth: int = 4,
+                  threads: int = 2, seed: int = 0) -> Optional[NativeLoader]:
+    if not available():
+        return None
+    return NativeLoader(KIND_IMAGES,
+                        (batch_size, image_size, image_size, 3),
+                        num_classes, depth=depth, threads=threads, seed=seed)
+
+
+def create_tokens(batch_size: int, seq_len: int, vocab_size: int,
+                  depth: int = 4, threads: int = 2,
+                  seed: int = 0) -> Optional[NativeLoader]:
+    if not available():
+        return None
+    return NativeLoader(KIND_TOKENS, (batch_size, seq_len, 0, 0),
+                        vocab_size, depth=depth, threads=threads, seed=seed)

@@ -66,6 +66,39 @@ class SyntheticImages:
             }
 
 
+def images_pipeline(batch_size: int, image_size: int = 224,
+                    num_classes: int = 1000, seed: int = 0,
+                    prefetch_depth: int = 4, threads: int = 2
+                    ) -> Iterator[Dict[str, np.ndarray]]:
+    """Image input pipeline: the native C++ prefetching loader when
+    available (producer threads + ring buffer, no GIL), else the Python
+    generator. Yields {"inputs": f32 [B,H,W,3], "labels": i32 [B]}."""
+    from tf_operator_tpu.native import prefetch
+
+    loader = prefetch.create_images(batch_size, image_size, num_classes,
+                                    depth=prefetch_depth, threads=threads,
+                                    seed=seed)
+    if loader is not None:
+        return loader
+    return iter(SyntheticImages(batch_size, image_size, num_classes,
+                                seed=seed))
+
+
+def lm_pipeline(batch_size: int, seq_len: int, vocab_size: int,
+                seed: int = 0, prefetch_depth: int = 4,
+                threads: int = 2) -> Iterator[Dict[str, np.ndarray]]:
+    """Token input pipeline (native prefetch when available). Yields
+    {"inputs": i32 [B, S+1]} — S+1 so the trainer can shift."""
+    from tf_operator_tpu.native import prefetch
+
+    loader = prefetch.create_tokens(batch_size, seq_len + 1, vocab_size,
+                                    depth=prefetch_depth, threads=threads,
+                                    seed=seed)
+    if loader is not None:
+        return loader
+    return iter(SyntheticLM(batch_size, seq_len, vocab_size, seed=seed))
+
+
 class DeviceFeeder:
     """Background thread that stages host batches onto the device(s) one
     step ahead (hides host->HBM transfer behind compute)."""
