@@ -121,11 +121,18 @@ class LocalProcessBackend:
         pod = rp.pod
         if not self._await_gang_admission(rp):
             return  # pod deleted while gated
+        if rp.stop_requested:
+            return  # deleted between admission and spawn
         try:
             self._spawn_all(rp)
         except Exception as e:  # bad command etc. -> Failed
             log.warning("pod %s failed to start: %s", key, e)
             self._write_status(pod, PodPhase.FAILED, message=str(e))
+            return
+        if rp.stop_requested:
+            # Deletion raced the spawn: _terminate saw an empty process
+            # map, so these processes would otherwise leak.
+            self._terminate(rp)
             return
         self._write_running(rp)
         self._wait_pod(key, rp)
