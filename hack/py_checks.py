@@ -1,0 +1,99 @@
+#!/usr/bin/env python3
+"""Static checks for CI (reference: py/kubeflow/tf_operator/py_checks.py).
+
+Runs, in order:
+  1. byte-compilation of every tracked .py file (syntax gate);
+  2. pyflakes when available (skipped with a notice otherwise — no
+     network installs in the build image);
+  3. the generated-artifact freshness checks (manifests/docs codegen),
+     the verify-codegen.sh analog.
+
+Exit code is non-zero on any failure so CI can gate merges on it.
+"""
+
+from __future__ import annotations
+
+import compileall
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECK_DIRS = ["tf_operator_tpu", "tests", "examples", "hack", "manifests",
+              "docs"]
+
+
+def check_compile() -> bool:
+    ok = True
+    for d in CHECK_DIRS:
+        path = os.path.join(ROOT, d)
+        if os.path.isdir(path):
+            ok = compileall.compile_dir(path, quiet=1, force=True) and ok
+    for f in ("bench.py", "__graft_entry__.py"):
+        ok = compileall.compile_file(os.path.join(ROOT, f), quiet=1) and ok
+    return bool(ok)
+
+
+def check_pyflakes() -> bool:
+    try:
+        import pyflakes  # noqa: F401
+    except ImportError:
+        print("py_checks: pyflakes not installed, skipping lint pass")
+        return True
+    targets = [os.path.join(ROOT, d) for d in CHECK_DIRS
+               if os.path.isdir(os.path.join(ROOT, d))]
+    proc = subprocess.run([sys.executable, "-m", "pyflakes", *targets])
+    return proc.returncode == 0
+
+
+GENERATED = [
+    ("manifests/gen.py", "manifests/base/tpujob.schema.json"),
+    ("docs/gen_api.py", "docs/api.md"),
+]
+
+
+def check_generated_fresh() -> bool:
+    """Re-run each generator and diff its output against the checked-in
+    artifact, restoring the original afterwards (verify-codegen.sh
+    analog)."""
+    ok = True
+    for gen, artifact in GENERATED:
+        path = os.path.join(ROOT, artifact)
+        with open(path, "rb") as f:
+            before = f.read()
+        try:
+            proc = subprocess.run([sys.executable, os.path.join(ROOT, gen)],
+                                  capture_output=True, text=True)
+            if proc.returncode != 0:
+                print(f"py_checks: {gen} failed:\n{proc.stderr}")
+                ok = False
+                continue
+            with open(path, "rb") as f:
+                after = f.read()
+            if after != before:
+                print(f"py_checks: {artifact} is stale — run "
+                      "hack/update-codegen.sh and commit the result")
+                ok = False
+        finally:
+            with open(path, "wb") as f:
+                f.write(before)
+    return ok
+
+
+def main() -> int:
+    checks = [("compile", check_compile), ("pyflakes", check_pyflakes),
+              ("generated-fresh", check_generated_fresh)]
+    failed = []
+    for name, fn in checks:
+        print(f"py_checks: running {name}")
+        if not fn():
+            failed.append(name)
+    if failed:
+        print(f"py_checks: FAILED: {', '.join(failed)}")
+        return 1
+    print("py_checks: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

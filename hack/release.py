@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""Release tooling (reference: py/kubeflow/tf_operator/release.py +
+build_and_push_image.py).
+
+Builds versioned artifacts from a clean tree:
+  - stamps tf_operator_tpu/version.py GIT_SHA with the current commit;
+  - builds an sdist + wheel into dist/ via `python -m build` when
+    available, falling back to `pip wheel`/setuptools;
+  - prints the docker build command for the operator image
+    (build/images/tpu_operator/Dockerfile) — the image build itself runs
+    in CI where a docker daemon exists.
+
+Usage: python hack/release.py [--version X.Y.Z] [--no-stamp]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+VERSION_FILE = os.path.join(ROOT, "tf_operator_tpu", "version.py")
+PYPROJECT = os.path.join(ROOT, "pyproject.toml")
+
+
+def git_sha() -> str:
+    try:
+        out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             cwd=ROOT, capture_output=True, text=True)
+        return out.stdout.strip() or "unknown"
+    except OSError:
+        return "unknown"
+
+
+def stamp(version: str | None, sha: str) -> None:
+    with open(VERSION_FILE) as f:
+        src = f.read()
+    src = re.sub(r'GIT_SHA = "[^"]*"', f'GIT_SHA = "{sha}"', src)
+    if version:
+        src = re.sub(r'__version__ = "[^"]*"',
+                     f'__version__ = "{version}"', src)
+    with open(VERSION_FILE, "w") as f:
+        f.write(src)
+    if version:  # keep wheel metadata in lockstep with version_string()
+        with open(PYPROJECT) as f:
+            proj = f.read()
+        proj = re.sub(r'^version = "[^"]*"', f'version = "{version}"',
+                      proj, flags=re.M)
+        with open(PYPROJECT, "w") as f:
+            f.write(proj)
+    print(f"release: stamped {VERSION_FILE} (sha={sha}"
+          + (f", version={version})" if version else ")"))
+
+
+def build_dist() -> bool:
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    try:
+        import build  # noqa: F401
+        cmd = [sys.executable, "-m", "build", "--sdist", "--wheel",
+               "--outdir", "dist"]
+    except ImportError:
+        cmd = [sys.executable, "-m", "pip", "wheel", "--no-deps",
+               "--no-build-isolation", "-w", "dist", "."]
+    print(f"release: {' '.join(cmd)}")
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode == 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--version", default=None,
+                    help="override package version (default: keep current)")
+    ap.add_argument("--no-stamp", action="store_true",
+                    help="skip GIT_SHA stamping")
+    args = ap.parse_args()
+
+    if not args.no_stamp:
+        stamp(args.version, git_sha())
+    if not build_dist():
+        print("release: dist build FAILED")
+        return 1
+    print("release: artifacts in dist/")
+    print("release: operator image: docker build -f "
+          "build/images/tpu_operator/Dockerfile -t tpu-operator:"
+          f"{git_sha()} .")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

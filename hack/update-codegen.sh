@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Regenerate all checked-in generated artifacts (reference:
+# hack/update-codegen.sh + hack/generate-apidoc.sh). The freshness check
+# (verify-codegen.sh analog) is tests/test_manifests.py and
+# hack/py_checks.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python manifests/gen.py
+python docs/gen_api.py
+echo "update-codegen: done"
