@@ -1,0 +1,116 @@
+"""Benchmark: pod-to-AllReplicasReady latency (BASELINE north-star #2).
+
+Runs the full control loop hermetically — real controller, real store,
+real subprocess pods running the worker stub — and measures the time
+from job creation to the AllReplicasReady latch
+(`status.all_replicas_ready_time`, observed by the controller into the
+`tpu_operator_all_replicas_ready_seconds` histogram; see
+tf_operator_tpu/controller/status.py).
+
+Reference analog: the reference has no such benchmark (SURVEY §6); its
+implicit SLO is the e2e wait budget (~10-15 min per job,
+py/kubeflow/tf_operator/tf_job_client.py:116-210). Here a 1-chief +
+4-worker gang (the ResNet-50 BASELINE topology) must reach
+AllReplicasReady in well under a second of controller work.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "seconds", "vs_baseline": N}
+vs_baseline = (reference implicit SLO lower bound, 600 s) / measured.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO_ROOT)
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import (
+    Container,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+    ReplicaSpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.operator import Operator
+from tf_operator_tpu.runtime.local import LocalProcessBackend
+from tf_operator_tpu.sdk import TPUJobClient
+
+REFERENCE_SLO_SECONDS = 600.0  # lower bound of the reference e2e wait budget
+
+
+def make_job(name: str, stub_dir: str, workers: int, chief: int) -> TPUJob:
+    def spec(n: int) -> ReplicaSpec:
+        return ReplicaSpec(
+            replicas=n,
+            template=PodTemplateSpec(spec=PodSpec(containers=[Container(
+                name=constants.DEFAULT_CONTAINER_NAME,
+                command=[sys.executable, "-m",
+                         "tf_operator_tpu.runtime.worker_stub"],
+                env={"TPUJOB_STUB_DIR": stub_dir},
+            )])))
+
+    replica_specs = {"worker": spec(workers)}
+    if chief:
+        replica_specs["chief"] = spec(chief)
+    return TPUJob(metadata=ObjectMeta(name=name),
+                  spec=TPUJobSpec(replica_specs=replica_specs))
+
+
+def measure_once(trial: int, workers: int, chief: int) -> float:
+    backend = LocalProcessBackend(
+        store=None, workdir=REPO_ROOT,
+        extra_env={"PYTHONPATH": REPO_ROOT + os.pathsep
+                   + os.environ.get("PYTHONPATH", "")})
+    op = Operator(backend=backend)
+    backend.store = op.store
+    op.start(threadiness=2)
+    try:
+        client = TPUJobClient(op.store)
+        with tempfile.TemporaryDirectory() as stub_dir:
+            job = make_job(f"bench-ready-{trial}", stub_dir, workers, chief)
+            t0 = time.monotonic()
+            client.create(job)
+            deadline = t0 + 120.0
+            while time.monotonic() < deadline:
+                got = client.get(job.metadata.name)
+                if got and got.status.all_replicas_ready_time is not None:
+                    dt = time.monotonic() - t0
+                    client.delete(job.metadata.name)
+                    return dt
+                time.sleep(0.01)
+        raise TimeoutError("AllReplicasReady never latched")
+    finally:
+        op.stop()
+
+
+def main() -> int:
+    workers, chief, trials = 4, 1, 3
+    try:
+        latencies = [measure_once(i, workers, chief) for i in range(trials)]
+        best = min(latencies)
+        print(json.dumps({
+            "metric": f"pod_to_all_replicas_ready_seconds[{chief}c+{workers}w]",
+            "value": round(best, 3),
+            "unit": "seconds",
+            "vs_baseline": round(REFERENCE_SLO_SECONDS / best, 1),
+        }))
+        return 0
+    except Exception as e:
+        print(json.dumps({
+            "metric": "pod_to_all_replicas_ready_seconds",
+            "value": 0.0, "unit": "seconds", "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,13 @@ import json
 import os
 import sys
 
+# Allow running standalone (python examples/<dir>/<file>.py) without PYTHONPATH.
+import os as _os
+import sys as _sys
+_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
 
 def maybe_init_distributed() -> int:
     """Returns this process's rank (0 when not distributed).

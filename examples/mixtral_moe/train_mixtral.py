@@ -1,16 +1,15 @@
-"""Flagship SPMD decoder training (the BASELINE Llama config family).
+"""Mixtral-style MoE expert-parallel training (BASELINE config).
 
-No reference analog — the reference delegates training to user
-containers; here the harness is in-repo. Builds a dp/fsdp/tp mesh over
-the visible devices, shards the model by the logical-axis rule table,
-and trains on synthetic token data. `--size tiny` (default) runs
-anywhere; `--size 8b` is the real v5p-slice config.
+Reference analog: the "Mixtral 8x7B MoE expert-parallel TFJob across
+multi-slice v5p (DCN all-to-all)" BASELINE config. Experts shard over
+the ep mesh axis (GShard einsum dispatch); multislice runs put dcn as
+the outermost mesh axis so the expert all-to-all rides ICI within a
+slice and gradient all-reduce rides DCN across slices.
 """
 
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
 # Allow running standalone (python examples/<dir>/<file>.py) without PYTHONPATH.
@@ -23,12 +22,12 @@ if _REPO_ROOT not in _sys.path:
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--size", choices=["tiny", "8b"], default="tiny")
+    ap.add_argument("--size", choices=["tiny", "8x7b"], default="tiny")
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--batch-size", type=int, default=8)
-    ap.add_argument("--seq-len", type=int, default=128)
-    ap.add_argument("--fsdp", type=int, default=1)
-    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--ep", type=int, default=1)
+    ap.add_argument("--num-slices", type=int, default=1)
     args = ap.parse_args()
 
     import jax
@@ -36,40 +35,42 @@ def main() -> int:
     import numpy as np
     import optax
 
-    from tf_operator_tpu.models.llama import (
-        Llama,
-        llama_3_8b,
-        llama_tiny,
+    from tf_operator_tpu.models.mixtral import (
+        Mixtral,
+        make_moe_lm_loss,
+        mixtral_8x7b,
+        mixtral_tiny,
         param_logical_axes,
     )
     from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh, use_mesh
-    from tf_operator_tpu.parallel.sharding import LLAMA_RULES
+    from tf_operator_tpu.parallel.sharding import MOE_RULES
     from tf_operator_tpu.train.trainer import Trainer
 
-    if args.size == "8b":
-        cfg = llama_3_8b()
+    if args.size == "8x7b":
+        cfg = mixtral_8x7b()
     else:
-        cfg = llama_tiny(vocab_size=512, max_seq_len=args.seq_len * 2)
+        cfg = mixtral_tiny(max_seq_len=args.seq_len * 2)
 
-    mesh = make_mesh(MeshConfig(dp=-1, fsdp=args.fsdp, tp=args.tp))
+    mesh = make_mesh(MeshConfig(dcn=args.num_slices, dp=-1, ep=args.ep))
     print("mesh:", dict(mesh.shape))
-    trainer = Trainer(model=Llama(cfg), param_axes_fn=param_logical_axes,
-                      rules=LLAMA_RULES, mesh=mesh,
-                      optimizer=optax.adamw(3e-4))
+    trainer = Trainer(model=Mixtral(cfg), param_axes_fn=param_logical_axes,
+                      rules=MOE_RULES, mesh=mesh,
+                      optimizer=optax.adamw(1e-4),
+                      loss_fn=make_moe_lm_loss(cfg.aux_loss_weight))
     rng = jax.random.PRNGKey(0)
     sample = {"inputs": jnp.zeros((args.batch_size, args.seq_len + 1),
                                   jnp.int32)}
+    data_rng = np.random.default_rng(0)
     with use_mesh(mesh):
         state, shardings = trainer.init(rng, sample)
         step = trainer.make_train_step(shardings, sample)
-        data_rng = np.random.default_rng(0)
         for i in range(args.steps):
             tokens = jnp.asarray(data_rng.integers(
                 0, cfg.vocab_size, (args.batch_size, args.seq_len + 1)),
                 jnp.int32)
             state, metrics = step(state, {"inputs": tokens})
             print(f"step {i}: loss={float(metrics['loss']):.4f}")
-    print("llama training OK")
+    print("mixtral training OK")
     return 0
 
 

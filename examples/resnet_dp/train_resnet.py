@@ -1,0 +1,69 @@
+"""ResNet-50 data-parallel training (the BASELINE headline config).
+
+Reference analog: the "ResNet-50/ImageNet TFJob, 1 Chief + 4 Workers
+(MultiWorkerMirroredStrategy)" BASELINE config. The reference operator
+delegates this to user containers reading TF_CONFIG
+(/root/reference/examples/v1/distribution_strategy/); here the payload
+is the in-repo JAX harness: pure data-parallel over the dp mesh axis,
+BatchNorm statistics become global-batch statistics under GSPMD.
+
+`--size tiny` (default) runs anywhere; `--size 50` is the real config
+benchmarked by bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+# Allow running standalone (python examples/<dir>/<file>.py) without PYTHONPATH.
+import os as _os
+import sys as _sys
+_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--size", choices=["tiny", "50"], default="tiny")
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--image-size", type=int, default=32)
+    args = ap.parse_args()
+
+    import jax
+    import optax
+
+    from tf_operator_tpu.models import resnet as rn
+    from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh, use_mesh
+    from tf_operator_tpu.parallel.sharding import CNN_RULES
+    from tf_operator_tpu.train.trainer import Trainer, classification_loss
+
+    if args.size == "50":
+        cfg = rn.resnet50()
+    else:
+        cfg = rn.resnet_tiny()
+
+    mesh = make_mesh(MeshConfig(dp=-1))
+    print("mesh:", dict(mesh.shape))
+    trainer = Trainer(model=rn.ResNet(cfg), param_axes_fn=rn.param_logical_axes,
+                      rules=CNN_RULES, mesh=mesh,
+                      optimizer=optax.sgd(0.1, momentum=0.9),
+                      loss_fn=classification_loss)
+    rng = jax.random.PRNGKey(0)
+    batch = rn.synthetic_batch(rng, batch_size=args.batch_size,
+                               image_size=args.image_size,
+                               num_classes=cfg.num_classes)
+    with use_mesh(mesh):
+        state, shardings = trainer.init(rng, batch)
+        step = trainer.make_train_step(shardings, batch)
+        for i in range(args.steps):
+            state, metrics = step(state, batch)
+            print(f"step {i}: loss={float(metrics['loss']):.4f}")
+    print("resnet training OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

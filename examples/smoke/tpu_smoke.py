@@ -11,6 +11,13 @@ import json
 import os
 import sys
 
+# Allow running standalone (python examples/<dir>/<file>.py) without PYTHONPATH.
+import os as _os
+import sys as _sys
+_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
 
 def main() -> int:
     bootstrap = {k: v for k, v in sorted(os.environ.items())
@@ -20,6 +27,9 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    from tf_operator_tpu.parallel.mesh import apply_platform_env
+
+    apply_platform_env()
     for device in jax.local_devices():
         x = jax.device_put(jnp.ones((256, 256), jnp.bfloat16), device)
         y = jax.jit(lambda a: (a @ a).sum(), device=device)(x)

@@ -32,6 +32,24 @@ from jax.sharding import Mesh
 
 AXIS_ORDER = ("dcn", "dp", "fsdp", "pp", "sp", "tp", "ep")
 
+
+def apply_platform_env() -> None:
+    """Honor ``JAX_PLATFORMS`` even when a site-installed PJRT plugin
+    pins the backend at interpreter startup.
+
+    Some TPU plugin sitecustomize hooks register themselves and claim
+    the default backend regardless of the ``JAX_PLATFORMS`` env var.
+    Payloads that are told ``JAX_PLATFORMS=cpu`` (hermetic e2e, CI)
+    call this before the first device query; jax.config wins over the
+    plugin's pin. No-op when the env var is unset; a silent no-op if
+    the backend is already initialized.
+    """
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        jax.config.update("jax_platforms", want)
+
 # Ambient mesh: models reach it for nested shard_map regions (ring
 # attention, MoE dispatch) without threading a Mesh through module attrs.
 _MESH_STACK: list = []
@@ -92,6 +110,7 @@ def make_mesh(config: Optional[MeshConfig] = None,
     """
     config = config or MeshConfig()
     if devices is None:
+        apply_platform_env()
         devices = jax.devices()
     devices = np.asarray(devices)
     sizes = config.resolve(devices.size)
