@@ -61,6 +61,9 @@ def bench_resnet50(batch_size: int, image_size: int, steps: int,
     rng = jax.random.PRNGKey(0)
     batch = rn.synthetic_batch(rng, batch_size=batch_size,
                                image_size=image_size)
+    # Feed bf16 images: the standard TPU input pipeline emits bf16, and
+    # it saves the per-step f32->bf16 cast of the image tensor.
+    batch["inputs"] = batch["inputs"].astype(jnp.bfloat16)
     batch = {k: jnp.asarray(v) for k, v in batch.items()}
     state, shardings = trainer.init(rng, batch)
     step = trainer.make_train_step(shardings, batch)

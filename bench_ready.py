@@ -65,12 +65,7 @@ def make_job(name: str, stub_dir: str, workers: int, chief: int) -> TPUJob:
 
 
 def measure_once(trial: int, workers: int, chief: int) -> float:
-    backend = LocalProcessBackend(
-        store=None, workdir=REPO_ROOT,
-        extra_env={"PYTHONPATH": REPO_ROOT + os.pathsep
-                   + os.environ.get("PYTHONPATH", "")})
-    op = Operator(backend=backend)
-    backend.store = op.store
+    op = Operator.local(workdir=REPO_ROOT)
     op.start(threadiness=2)
     try:
         client = TPUJobClient(op.store)

@@ -16,11 +16,9 @@ import os
 import sys
 
 # Allow running standalone (python examples/<dir>/<file>.py) without PYTHONPATH.
-import os as _os
-import sys as _sys
-_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
-if _REPO_ROOT not in _sys.path:
-    _sys.path.insert(0, _REPO_ROOT)
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 
 def maybe_init_distributed() -> int:
@@ -47,6 +45,11 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="save/resume training state here (orbax)")
+    ap.add_argument("--crash-at-step", type=int, default=-1,
+                    help="exit with a retryable code at this step on a "
+                         "fresh start (restart/resume e2e fault injection)")
     args = ap.parse_args()
 
     spec = os.environ.get("TPUJOB_CLUSTER_SPEC")
@@ -71,22 +74,84 @@ def main() -> int:
                       optimizer=optax.adam(1e-3),
                       loss_fn=classification_loss)
     rng = jax.random.PRNGKey(0)
-    batch = {k: jnp.asarray(v) for k, v in
-             synthetic_batch(rng, batch_size=args.batch_size).items()}
-    state, shardings = trainer.init(rng, batch)
+
+    # Multihost feeding contract: --batch-size is the GLOBAL batch; each
+    # process synthesizes only its local shard and the global array is
+    # assembled from per-process shards (the global batch never exists
+    # on one host).
+    import numpy as np
+
+    nproc = jax.process_count()
+    local_bs = max(args.batch_size // nproc, 1)
+
+    def local_shard(step_idx: int):
+        key = jax.random.PRNGKey(step_idx * nproc + jax.process_index())
+        return {k: np.asarray(v) for k, v in
+                synthetic_batch(key, batch_size=local_bs).items()}
+
+    if nproc > 1:
+        from tf_operator_tpu.train.data import multihost_batch
+
+        batch_sh = trainer.batch_shardings(local_shard(0))
+        make_batch = lambda i: multihost_batch(local_shard(i), batch_sh)
+        print(f"distributed: {nproc} processes, "
+              f"{jax.device_count()} global devices")
+    else:
+        make_batch = lambda i: {k: jnp.asarray(v)
+                                for k, v in local_shard(i).items()}
+
+    batch = make_batch(0)
+
+    # Checkpoint/resume: a restarted replica (same index, fresh pod)
+    # picks up from the latest saved step instead of step 0 — this is
+    # what makes the ExitCode restart policy actually resume work. On
+    # resume, params land directly in their shardings (no wasted init).
+    ckpt = None
+    state = None
+    start_step = 0
+    fresh_start = True
+    shardings = trainer.state_shardings(rng, batch)
+    if args.checkpoint_dir:
+        from tf_operator_tpu.train.checkpoint import (
+            Checkpointer,
+            abstract_state_with_shardings,
+        )
+
+        ckpt = Checkpointer(os.path.abspath(args.checkpoint_dir))
+        latest = ckpt.latest_step()
+        if latest is not None:
+            abstract = abstract_state_with_shardings(
+                trainer._init_fn, shardings, rng, batch)
+            state = ckpt.restore(abstract)
+            start_step = int(state.step)
+            fresh_start = False
+            print(f"resumed from checkpoint at step {latest}")
+    if state is None:
+        state, shardings = trainer.init(rng, batch)
     step = trainer.make_train_step(shardings, batch)
 
     first = last = None
-    for i in range(args.steps):
-        batch = {k: jnp.asarray(v) for k, v in synthetic_batch(
-            jax.random.PRNGKey(i + 1), batch_size=args.batch_size).items()}
+    for i in range(start_step, args.steps):
+        batch = make_batch(i + 1)
         state, metrics = step(state, batch)
         loss = float(metrics["loss"])
         first = loss if first is None else first
         last = loss
         if rank == 0 and (i % 5 == 0 or i == args.steps - 1):
             print(f"step {i}: loss={loss:.4f}")
-    print(f"done: loss {first:.4f} -> {last:.4f}")
+        if ckpt is not None:
+            ckpt.save(int(state.step), state)
+        if fresh_start and i + 1 == args.crash_at_step:
+            if ckpt is not None:
+                ckpt.wait()
+            print(f"injected crash at step {i + 1}", flush=True)
+            return 137  # SIGKILL-class: retryable under ExitCode policy
+    if ckpt is not None:
+        ckpt.close()
+    if first is None:  # resumed at or past the final step: nothing to do
+        print("done: no steps remaining after resume")
+    else:
+        print(f"done: loss {first:.4f} -> {last:.4f}")
     return 0
 
 

@@ -32,12 +32,7 @@ def main() -> int:
     with open(args.spec) as f:
         job = TPUJob.from_dict(yaml.safe_load(f))
 
-    backend = LocalProcessBackend(
-        store=None, workdir=REPO_ROOT,
-        extra_env={"PYTHONPATH": REPO_ROOT + os.pathsep
-                   + os.environ.get("PYTHONPATH", "")})
-    op = Operator(backend=backend)
-    backend.store = op.store
+    op = Operator.local(workdir=REPO_ROOT)
     op.start(threadiness=2)
     client = TPUJobClient(op.store)
     try:

@@ -50,3 +50,20 @@ class Operator:
         if self.backend is not None:
             self.backend.stop()
         self.store.stop_watchers()
+
+    @classmethod
+    def local(cls, workdir: str, extra_env: Optional[dict] = None,
+              **kwargs) -> "Operator":
+        """Operator wired to a subprocess pod backend rooted at
+        ``workdir``, with ``workdir`` importable inside pods. The common
+        bootstrap for hermetic e2e, examples, and benchmarks."""
+        import os
+
+        env = {"PYTHONPATH": workdir + os.pathsep
+               + os.environ.get("PYTHONPATH", "")}
+        env.update(extra_env or {})
+        backend = LocalProcessBackend(store=None, workdir=workdir,
+                                      extra_env=env)
+        op = cls(backend=backend, **kwargs)
+        backend.store = op.store
+        return op
