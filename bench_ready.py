@@ -40,7 +40,6 @@ from tf_operator_tpu.api.types import (
     TPUJobSpec,
 )
 from tf_operator_tpu.operator import Operator
-from tf_operator_tpu.runtime.local import LocalProcessBackend
 from tf_operator_tpu.sdk import TPUJobClient
 
 REFERENCE_SLO_SECONDS = 600.0  # lower bound of the reference e2e wait budget

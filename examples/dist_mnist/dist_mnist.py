@@ -112,16 +112,12 @@ def main() -> int:
     fresh_start = True
     shardings = trainer.state_shardings(rng, batch)
     if args.checkpoint_dir:
-        from tf_operator_tpu.train.checkpoint import (
-            Checkpointer,
-            abstract_state_with_shardings,
-        )
+        from tf_operator_tpu.train.checkpoint import Checkpointer
 
         ckpt = Checkpointer(os.path.abspath(args.checkpoint_dir))
         latest = ckpt.latest_step()
         if latest is not None:
-            abstract = abstract_state_with_shardings(
-                trainer._init_fn, shardings, rng, batch)
+            abstract = trainer.abstract_state(rng, batch, shardings)
             state = ckpt.restore(abstract)
             start_step = int(state.step)
             fresh_start = False

@@ -19,7 +19,6 @@ sys.path.insert(0, REPO_ROOT)
 
 from tf_operator_tpu.api.types import JobConditionType, TPUJob  # noqa: E402
 from tf_operator_tpu.operator import Operator  # noqa: E402
-from tf_operator_tpu.runtime.local import LocalProcessBackend  # noqa: E402
 from tf_operator_tpu.sdk.client import TPUJobClient  # noqa: E402
 
 

@@ -30,7 +30,6 @@ from tf_operator_tpu.api.types import (
 )
 from tf_operator_tpu.operator import Operator
 from tf_operator_tpu.runtime import store as store_mod
-from tf_operator_tpu.runtime.local import LocalProcessBackend
 from tf_operator_tpu.sdk import TPUJobClient
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

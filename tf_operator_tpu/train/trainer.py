@@ -145,6 +145,19 @@ class Trainer:
                             out_shardings=shardings)(rng, sample_batch)
         return state, shardings
 
+    def abstract_state(self, rng, sample_batch, shardings=None):
+        """Sharding-annotated abstract TrainState without materializing
+        anything — the checkpoint-restore target (StandardRestore), so a
+        resumed process never pays for a throwaway init."""
+        from tf_operator_tpu.train.checkpoint import (
+            abstract_state_with_shardings,
+        )
+
+        if shardings is None:
+            shardings = self.state_shardings(rng, sample_batch)
+        return abstract_state_with_shardings(
+            self._init_fn, shardings, rng, sample_batch)
+
     # -- step -----------------------------------------------------------
 
     def make_train_step(self, state_shardings, sample_batch):
