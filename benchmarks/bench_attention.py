@@ -1,0 +1,73 @@
+"""Attention kernel benchmark: pallas flash vs XLA reference.
+
+Produced the attention table in docs/benchmarks.md. Run on a TPU chip:
+    python benchmarks/bench_attention.py [--seq 2048] [--batch 8]
+Timing uses host-sync via float() (block_until_ready can return early
+on tunneled PJRT plugins).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, *args, n=10, warm=2):
+    for _ in range(warm):
+        out = fn(*args)
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    float(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.ops.flash_attention import flash_attention
+    from tf_operator_tpu.ops.layers import attention as xla_attention
+
+    B, H, S, D = args.batch, args.heads, args.seq, args.head_dim
+    peak = args.peak_tflops * 1e12
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                 (B, S, H, D), jnp.bfloat16) * 0.1
+               for i in range(3))
+    flops = 4 * B * H * S * S * D / 2  # causal
+
+    for name, fn in (("xla", xla_attention), ("flash", flash_attention)):
+        fwd = jax.jit(lambda q, k, v, f=fn:
+                      f(q, k, v, causal=True).astype(jnp.float32).sum())
+        dt = timeit(fwd, q, k, v)
+        print(json.dumps({"impl": name, "pass": "fwd",
+                          "ms": round(dt * 1e3, 2),
+                          "mfu": round(flops / dt / peak, 3)}))
+        grad = jax.jit(lambda q, k, v, f=fn: sum(
+            x.astype(jnp.float32).sum() for x in jax.grad(
+                lambda q, k, v: f(q, k, v, causal=True)
+                .astype(jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v)))
+        dt = timeit(grad, q, k, v)
+        print(json.dumps({"impl": name, "pass": "fwd+bwd",
+                          "ms": round(dt * 1e3, 2),
+                          "mfu": round(3.5 * flops / dt / peak, 3)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

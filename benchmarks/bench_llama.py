@@ -1,0 +1,95 @@
+"""Decoder training throughput benchmark (the Llama BASELINE family).
+
+Produced the Llama table in docs/benchmarks.md: a 570M-param decoder,
+single chip, bf16 compute / f32 state, remat on. Compare attention
+paths with --attention {auto,flash,xla,ring}.
+
+    python benchmarks/bench_llama.py --attention auto
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--attention", default="",
+                    choices=["", "auto", "flash", "xla", "ring"])
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    ap.add_argument("--preset", default="570m", choices=["570m", "tiny"],
+                    help="tiny = CPU-smoke-sized model")
+    args = ap.parse_args()
+    impl = "" if args.attention == "auto" else args.attention
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tf_operator_tpu.models.llama import (
+        Llama,
+        LlamaConfig,
+        param_logical_axes,
+    )
+    from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh, use_mesh
+    from tf_operator_tpu.parallel.sharding import LLAMA_RULES
+    from tf_operator_tpu.train.trainer import Trainer
+
+    if args.preset == "tiny":
+        cfg = LlamaConfig(vocab_size=512, hidden=128, n_layers=2,
+                          n_heads=4, n_kv_heads=4, head_dim=32, mlp_dim=256,
+                          max_seq_len=args.seq, remat=False,
+                          attention_impl=impl, rope_theta=10000.0)
+    else:
+        cfg = LlamaConfig(vocab_size=32768, hidden=1024, n_layers=24,
+                          n_heads=16, n_kv_heads=16, head_dim=128,
+                          mlp_dim=4096, max_seq_len=args.seq, remat=True,
+                          attention_impl=impl)
+    B, S = args.batch, args.seq
+    sp = 2 if impl == "ring" else 1
+    mesh = make_mesh(MeshConfig(dp=-1, sp=sp))
+    trainer = Trainer(model=Llama(cfg), param_axes_fn=param_logical_axes,
+                      rules=LLAMA_RULES, mesh=mesh,
+                      optimizer=optax.adamw(1e-4))
+    rng = jax.random.PRNGKey(0)
+    sample = {"inputs": jnp.zeros((B, S + 1), jnp.int32)}
+    with use_mesh(mesh):
+        state, sh = trainer.init(rng, sample)
+        step = trainer.make_train_step(sh, sample)
+        tok = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (B, S + 1)), jnp.int32)
+        for _ in range(3):
+            state, m = step(state, {"inputs": tok})
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, m = step(state, {"inputs": tok})
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / args.steps
+
+    nparams = sum(x.size for x in jax.tree.leaves(state.params))
+    attn_fl = 3.5 * 4 * cfg.n_layers * cfg.n_heads * S * S \
+        * cfg.head_dim / 2 * B
+    flops = 6 * nparams * B * S + attn_fl
+    print(json.dumps({
+        "what": f"llama{nparams // 1_000_000}m_train[{args.attention or 'auto'}]",
+        "ms_per_step": round(dt * 1e3, 1),
+        "tokens_per_sec": round(B * S / dt),
+        "params": nparams,
+        "model_mfu": round(flops / dt / (args.peak_tflops * 1e12), 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

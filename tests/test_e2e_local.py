@@ -454,3 +454,55 @@ def test_distributed_jax_two_process_training(operator, client, tmp_path):
     assert "distributed: 2 processes" in logs["distmnist-worker-0"]
     assert "done:" in logs["distmnist-worker-0"]
     assert "done:" in logs["distmnist-worker-1"]
+
+
+def test_shutdown_policy_worker0_chiefless(operator, client, tmp_path):
+    """shutdown_policy_tests analog, chiefless half: with no chief,
+    worker-0's completion decides job success (reference status.go
+    worker-0 semantics) while siblings still run; they are then reaped
+    under the default CleanPodPolicy (Running)."""
+    stub_dir = str(tmp_path / "stub")
+    client.create(stub_job("w0done", stub_dir, worker=3))
+    client.wait_for_condition("w0done", JobConditionType.RUNNING, timeout=10)
+    tell(stub_dir, "w0done-worker-0", "exit:0")
+    job = client.wait_for_job("w0done", timeout=15)
+    assert testutil.check_condition(job, JobConditionType.SUCCEEDED)
+    # CleanPodPolicy Running deletes the still-running siblings but keeps
+    # the completed worker-0 pod (finished pods survive for log retrieval).
+    wait_for(lambda: client.get_pod_names("w0done") == ["w0done-worker-0"],
+             message="running siblings cleaned up")
+
+
+def test_concurrent_jobs_no_duplicate_creates(operator, client, tmp_path):
+    """Stress the expectations/workqueue machinery (the reference's
+    subtlest code, SURVEY §7 hard part (a)): many jobs reconciled
+    concurrently must create exactly one pod per replica index — a sync
+    racing a stale cache would double-create without the in-flight
+    expectations gate."""
+    from tf_operator_tpu.runtime import metrics
+
+    jobs, workers = 6, 3
+    before = metrics.created_pods.value(job_namespace="default")
+    stub_dir = str(tmp_path / "stub")
+    for i in range(jobs):
+        client.create(stub_job(f"burst-{i}", stub_dir, worker=workers,
+                               args=("--exit-after", "0.2")))
+    for i in range(jobs):
+        job = client.wait_for_job(f"burst-{i}", timeout=30)
+        assert testutil.check_condition(job, JobConditionType.SUCCEEDED)
+    after = metrics.created_pods.value(job_namespace="default")
+    assert after - before == jobs * workers, \
+        f"expected {jobs * workers} creates, saw {after - before}"
+    assert not operator.recorder.events_for(reason="FailedCreatePod")
+
+
+def test_sdk_events_visible(operator, client, tmp_path):
+    """Events persist to the store and are readable through the SDK
+    (reference get_creation_failures_from_tfjob scans K8s Events)."""
+    stub_dir = str(tmp_path / "stub")
+    client.create(stub_job("events", stub_dir, worker=1,
+                           args=("--exit-after", "0.2")))
+    client.wait_for_job("events", timeout=15)
+    reasons = {e.reason for e in client.get_events("events")}
+    assert "SuccessfulCreatePod" in reasons or "Created" in reasons, reasons
+    assert client.get_creation_failures("events") == []

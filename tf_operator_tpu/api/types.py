@@ -245,6 +245,22 @@ class Endpoint(ApiObject):
     spec: EndpointSpec = field(default_factory=EndpointSpec)
 
 
+@dataclasses.dataclass
+class EventRecord(ApiObject):
+    """Lifecycle event persisted to the store so clients can read it
+    (K8s Event analog; the reference harness scans Events for
+    FailedCreate, py/kubeflow/tf_operator/tf_job_client.py:363)."""
+
+    api_version: str = "v1"
+    kind: str = "Event"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_kind: str = ""
+    involved_name: str = ""
+    type: str = ""
+    reason: str = ""
+    message: str = ""
+
+
 # ---------------------------------------------------------------------------
 # Job spec
 # ---------------------------------------------------------------------------

@@ -8,14 +8,22 @@ monitoring endpoint attach here; see cli.py).
 from __future__ import annotations
 
 import logging
+import uuid
 from typing import Optional
 
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import EventRecord, ObjectMeta
 from tf_operator_tpu.controller.engine import EngineConfig
 from tf_operator_tpu.controller.gang import SliceGangScheduler
 from tf_operator_tpu.controller.tpu_controller import TPUJobController
 from tf_operator_tpu.runtime.events import Recorder
 from tf_operator_tpu.runtime.local import LocalProcessBackend
-from tf_operator_tpu.runtime.store import Store
+from tf_operator_tpu.runtime.store import EVENTS, Store
+
+# Store-mirrored events are capped like the in-memory Recorder: when the
+# collection exceeds MAX_STORED_EVENTS, the oldest PRUNE_BATCH are dropped.
+MAX_STORED_EVENTS = 4096
+PRUNE_BATCH = 512
 
 log = logging.getLogger("tpu_operator.operator")
 
@@ -28,7 +36,7 @@ class Operator:
                  enable_gang_scheduling: bool = False,
                  total_chips: Optional[int] = None):
         self.store = store or Store()
-        self.recorder = Recorder()
+        self.recorder = Recorder(sink=self._persist_event)
         config = config or EngineConfig()
         gang = None
         if enable_gang_scheduling:
@@ -44,6 +52,30 @@ class Operator:
             self.backend.start()
         self.controller.run(threadiness=threadiness)
         log.info("operator started (threadiness=%d)", threadiness)
+
+    def _persist_event(self, ev) -> None:
+        """Mirror recorder events into the store (K8s Event analog) so
+        SDK clients can scan them, e.g. for FailedCreatePod."""
+        job_name = ev.labels.get(constants.LABEL_JOB_NAME, "")
+        if not job_name and ev.object_kind == "TPUJob":
+            job_name = ev.object_name
+        record = EventRecord(
+            metadata=ObjectMeta(
+                name=f"{ev.object_name}.{uuid.uuid4().hex[:10]}",
+                namespace=ev.namespace or "default",
+                labels={constants.LABEL_JOB_NAME: job_name}),
+            involved_kind=ev.object_kind, involved_name=ev.object_name,
+            type=ev.type, reason=ev.reason, message=ev.message)
+        try:
+            self.store.create(EVENTS, record)
+            if self.store.count(EVENTS) > MAX_STORED_EVENTS:
+                stale = sorted(self.store.list(EVENTS),
+                               key=lambda e: e.metadata.resource_version)
+                for old in stale[:PRUNE_BATCH]:
+                    self.store.try_delete(EVENTS, old.metadata.namespace,
+                                          old.metadata.name)
+        except Exception:
+            log.debug("event persist failed", exc_info=True)
 
     def stop(self) -> None:
         self.controller.stop()

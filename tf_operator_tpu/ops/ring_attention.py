@@ -37,13 +37,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     b, s_blk, h, d = q.shape
     scale = d ** -0.5
 
-    q32 = q.astype(jnp.float32)
-
     def step(carry, _):
         k_blk, v_blk, src_idx, num, den, m = carry
 
-        logits = jnp.einsum("bshd,bthd->bhst", q32,
-                            k_blk.astype(jnp.float32)) * scale
+        # bf16 operands on the MXU, f32 accumulation (a f32 einsum would
+        # run the MXU at 1/4 rate for no extra attention accuracy).
+        logits = jnp.einsum("bshd,bthd->bhst", q, k_blk,
+                            preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = my_idx * s_blk + jnp.arange(s_blk)
             k_pos = src_idx * s_blk + jnp.arange(s_blk)
@@ -62,7 +62,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # corr is [B,H,S]; num is [B,S,H,D] -> align as [B,S,H,1]
         corr_bs = corr.transpose(0, 2, 1)[..., None]
         num_upd = (num * corr_bs
-                   + jnp.einsum("bhst,bthd->bshd", p, v_blk.astype(jnp.float32)))
+                   + jnp.einsum("bhst,bthd->bshd", p.astype(v_blk.dtype),
+                                v_blk, preferred_element_type=jnp.float32))
         den_upd = den * corr + jnp.sum(p, axis=-1)
 
         num = jnp.where(block_visible, num_upd, num)

@@ -25,6 +25,9 @@ class Event:
     message: str
     timestamp: _dt.datetime = field(
         default_factory=lambda: _dt.datetime.now(_dt.timezone.utc))
+    # The involved object's labels (job-name etc.) so sinks can attribute
+    # pod events to their job without name parsing.
+    labels: dict = field(default_factory=dict)
 
 
 class Recorder:
@@ -44,6 +47,7 @@ class Recorder:
             object_name=getattr(meta, "name", "") if meta else "",
             namespace=getattr(meta, "namespace", "") if meta else "",
             type=etype, reason=reason, message=message,
+            labels=dict(getattr(meta, "labels", None) or {}) if meta else {},
         )
         log.debug("%s %s %s/%s: %s", etype, reason, ev.namespace,
                   ev.object_name, message)

@@ -182,6 +182,11 @@ class Store:
         except NotFoundError:
             return False
 
+    def count(self, kind: str) -> int:
+        """Object count without the deepcopy cost of list()."""
+        with self._lock:
+            return len(self._objects.get(kind, {}))
+
     # -- watch ------------------------------------------------------------
 
     def watch(self, kind: str,
@@ -222,3 +227,4 @@ TPUJOBS = "tpujobs"
 PODS = "pods"
 ENDPOINTS = "endpoints"
 SLICEGROUPS = "slicegroups"
+EVENTS = "events"

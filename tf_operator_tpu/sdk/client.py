@@ -16,7 +16,7 @@ from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import JobConditionType, Pod, TPUJob
 from tf_operator_tpu.controller import conditions as cond
 from tf_operator_tpu.runtime import store as store_mod
-from tf_operator_tpu.runtime.store import Store
+from tf_operator_tpu.runtime.store import EVENTS, Store
 
 
 class TimeoutError_(TimeoutError):
@@ -210,3 +210,21 @@ class TPUJobClient:
             for pod_name in self.get_pod_names(
                 name, namespace=namespace, replica_type=replica_type)
         }
+
+    def get_events(self, name: str, namespace: Optional[str] = None,
+                   reason: str = "") -> List:
+        """Lifecycle events for a job and its pods (K8s Events analog,
+        persisted by the operator's recorder, attributed by the job-name
+        label — never by name-prefix matching)."""
+        ns = namespace or self.namespace
+        selector = {constants.LABEL_JOB_NAME: name}
+        return [e for e in self.store.list(EVENTS, namespace=ns,
+                                           selector=selector)
+                if not reason or e.reason == reason]
+
+    def get_creation_failures(self, name: str,
+                              namespace: Optional[str] = None) -> List[str]:
+        """Messages of FailedCreate-class events for a job (reference
+        get_creation_failures_from_tfjob, tf_job_client.py:363)."""
+        return [e.message for e in self.get_events(name, namespace=namespace)
+                if e.reason.startswith("FailedCreate")]
