@@ -84,8 +84,11 @@ class LlamaAttention(nn.Module):
         # ring blocks only materialize inside the shard_map region below).
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
-        k = repeat_kv(k, cfg.n_heads // cfg.n_kv_heads)
-        v = repeat_kv(v, cfg.n_heads // cfg.n_kv_heads)
+        if cfg.attention_impl in ("ring", "xla"):
+            # These paths need full-head KV; the flash kernel reads the
+            # shared GQA head directly (no repeated copy in HBM).
+            k = repeat_kv(k, cfg.n_heads // cfg.n_kv_heads)
+            v = repeat_kv(v, cfg.n_heads // cfg.n_kv_heads)
 
         if cfg.attention_impl == "ring":
             from tf_operator_tpu.parallel.mesh import active_mesh, data_axes

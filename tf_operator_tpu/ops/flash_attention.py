@@ -116,9 +116,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *,
 
 def _fwd(q, k, v, causal, q_offset, block_q, block_k, interpret
          ) -> Tuple[jax.Array, jax.Array]:
-    """q/k/v: [B, H, S, D] -> (out [B,H,S,D], lse [B,H,S])."""
+    """q: [B, H, S, D]; k/v: [B, Hkv, S, D] with H % Hkv == 0 (GQA:
+    each group of H//Hkv query heads reads one shared KV head — the
+    kernel indexes it directly, so KV is never repeated in HBM).
+    Returns (out [B,H,S,D], lse [B,H,S,_SUBS])."""
     b, h, sq, d = q.shape
-    sk = k.shape[2]
+    h_kv, sk = k.shape[1], k.shape[2]
+    group = h // h_kv
     nq, nk = sq // block_q, sk // block_k
     scale = d ** -0.5
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
@@ -130,9 +134,11 @@ def _fwd(q, k, v, causal, q_offset, block_q, block_k, interpret
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h // group, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h // group, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -188,11 +194,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
-                scale: float, block_q: int, block_k: int, q_offset: int):
-    j, i = pl.program_id(2), pl.program_id(3)   # k block outer, q block inner
-    nq = pl.num_programs(3)
+                scale: float, block_q: int, block_k: int, q_offset: int,
+                group: int):
+    # Grid: (b, h_kv, nk, nq*group) — the innermost dim walks every
+    # (q block, group member) pair so dK/dV accumulate over the whole
+    # query-head group sharing this KV head (GQA).
+    j, t = pl.program_id(2), pl.program_id(3)
+    inner = pl.num_programs(3)
+    i = t // group
 
-    @pl.when(i == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -209,7 +220,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
         dk_acc[...] += _dot(ds.astype(q.dtype).T, q)
 
-    @pl.when(i == nq - 1)
+    @pl.when(t == inner - 1)
     def _finalize():
         dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
@@ -218,7 +229,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_impl(q, k, v, out, lse, do, causal, q_offset, block_q, block_k,
               interpret):
     b, h, sq, d = q.shape
-    sk = k.shape[2]
+    h_kv, sk = k.shape[1], k.shape[2]
+    group = h // h_kv
     nq, nk = sq // block_q, sk // block_k
     scale = d ** -0.5
     delta = jnp.broadcast_to(
@@ -227,7 +239,8 @@ def _bwd_impl(q, k, v, out, lse, do, causal, q_offset, block_q, block_k,
 
     qspec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
                          memory_space=pltpu.VMEM)
-    kspec = pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0),
+    kspec = pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h // group, j, 0),
                          memory_space=pltpu.VMEM)
     rowq = pl.BlockSpec((1, 1, block_q, _SUBS),
                         lambda b, h, i, j: (b, h, i, 0),
@@ -248,19 +261,24 @@ def _bwd_impl(q, k, v, out, lse, do, causal, q_offset, block_q, block_k,
         interpret=interpret,
     )(q, k, v, do, lse, delta)[0]
 
-    # dK/dV: iterate q blocks innermost for each k block.
-    qspec_t = pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0),
+    # dK/dV: grid over KV heads; the inner dim walks (q block, group
+    # member) pairs so every query head sharing this KV head accumulates.
+    def q_head(h, t):
+        return h * group + t % group
+
+    qspec_t = pl.BlockSpec((1, 1, block_q, d),
+                           lambda b, h, j, t: (b, q_head(h, t), t // group, 0),
                            memory_space=pltpu.VMEM)
-    kspec_t = pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0),
+    kspec_t = pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, t: (b, h, j, 0),
                            memory_space=pltpu.VMEM)
     rowq_t = pl.BlockSpec((1, 1, block_q, _SUBS),
-                          lambda b, h, j, i: (b, h, i, 0),
+                          lambda b, h, j, t: (b, q_head(h, t), t // group, 0),
                           memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale,
                           block_q=block_q, block_k=block_k,
-                          q_offset=q_offset),
-        grid=(b, h, nk, nq),
+                          q_offset=q_offset, group=group),
+        grid=(b, h_kv, nk, nq * group),
         in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, rowq_t, rowq_t],
         out_specs=[kspec_t, kspec_t],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -333,13 +351,20 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_q: int = 512, block_k: int = 1024,
                     interpret: bool = False) -> jax.Array:
     """Flash attention over [B, S, H, D] tensors (same layout as
-    ``ops.layers.attention``). Requires `flash_supported` shapes."""
+    ``ops.layers.attention``). GQA: k/v may carry fewer heads
+    [B, S, Hkv, D] with H % Hkv == 0 — the kernel reads the shared KV
+    head directly instead of requiring a repeated copy in HBM.
+    Requires `flash_supported` shapes."""
     bq = _fit_block(q.shape[1], block_q)
     bk = _fit_block(k.shape[1], block_k)
     if not flash_supported(q.shape[1], k.shape[1], q.shape[3], bq, bk):
         raise ValueError(
             f"flash_attention unsupported for shapes q={q.shape} "
             f"k={k.shape} (blocks {bq}/{bk}); use ops.layers.attention")
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"GQA head counts must divide: q heads {q.shape[2]}, "
+            f"kv heads {k.shape[2]}")
     qt = q.transpose(0, 2, 1, 3)   # [B,H,S,D]
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
@@ -374,19 +399,35 @@ def best_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    mesh=None, force_flash: bool = False) -> jax.Array:
     """Dispatch: pallas flash on TPU when shapes tile (through shard_map
     when a mesh is active so GSPMD can partition it), else the XLA
-    reference. ``force_flash`` always takes the pallas path (interpret
-    mode off-TPU) — shape errors surface instead of falling back."""
-    from tf_operator_tpu.ops.layers import attention
+    reference. Accepts GQA kv (fewer heads); the XLA fallback repeats
+    KV to full heads itself. ``force_flash`` always takes the pallas
+    path (interpret mode off-TPU) — shape errors surface instead of
+    falling back."""
+    from tf_operator_tpu.ops.layers import attention, repeat_kv
 
     sp_size = 1 if mesh is None else mesh.shape.get("sp", 1)
+    tp_size = 1 if mesh is None else mesh.shape.get("tp", 1)
+    # Under a mesh the head axis of q AND k/v is sharded over tp, so
+    # unrepeated GQA KV must still divide tp (llama_3_8b kv=8, tp=16
+    # would otherwise crash in shard_map instead of falling back).
     auto_ok = (on_tpu() and sp_size == 1
+               and q.shape[2] % k.shape[2] == 0
+               and k.shape[2] % tp_size == 0
                and flash_supported(q.shape[1], k.shape[1], q.shape[3]))
     if force_flash or auto_ok:
         interpret = not on_tpu()
         if mesh is not None:
+            if k.shape[2] % tp_size:
+                # forced-flash with tp-indivisible GQA KV: repeat to
+                # full heads so the head sharding stays legal.
+                group = q.shape[2] // k.shape[2]
+                k, v = repeat_kv(k, group), repeat_kv(v, group)
             return flash_attention_sharded(q, k, v, mesh, causal=causal,
                                            q_offset=q_offset,
                                            interpret=interpret)
         return flash_attention(q, k, v, causal=causal, q_offset=q_offset,
                                interpret=interpret)
+    group = q.shape[2] // k.shape[2]
+    if group > 1:
+        k, v = repeat_kv(k, group), repeat_kv(v, group)
     return attention(q, k, v, causal=causal, q_offset=q_offset)
