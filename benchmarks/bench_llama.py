@@ -28,6 +28,8 @@ def main() -> int:
     ap.add_argument("--peak-tflops", type=float, default=197.0)
     ap.add_argument("--preset", default="570m", choices=["570m", "tiny"],
                     help="tiny = CPU-smoke-sized model")
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="override n_kv_heads (GQA; default = n_heads)")
     args = ap.parse_args()
     impl = "" if args.attention == "auto" else args.attention
 
@@ -55,6 +57,13 @@ def main() -> int:
                           n_heads=16, n_kv_heads=16, head_dim=128,
                           mlp_dim=4096, max_seq_len=args.seq, remat=True,
                           attention_impl=impl)
+    if args.kv_heads is not None:
+        import dataclasses
+
+        if args.kv_heads < 1 or cfg.n_heads % args.kv_heads:
+            ap.error(f"--kv-heads must divide n_heads={cfg.n_heads}; "
+                     f"got {args.kv_heads}")
+        cfg = dataclasses.replace(cfg, n_kv_heads=args.kv_heads)
     B, S = args.batch, args.seq
     sp = 2 if impl == "ring" else 1
     mesh = make_mesh(MeshConfig(dp=-1, sp=sp))

@@ -288,3 +288,25 @@ def test_cli_server_end_to_end(tmp_path):
         assert "tpu_operator_is_leader 1" in body
     finally:
         server.shutdown()
+
+
+def test_event_store_mirror_capped():
+    """Persisted events are labeled with their job name and the
+    collection is pruned once it exceeds the cap (no unbounded growth
+    on a long-running operator)."""
+    from tf_operator_tpu import operator as op_mod
+    from tf_operator_tpu.api import constants
+    from tf_operator_tpu.api.types import ObjectMeta, Pod
+    from tf_operator_tpu.operator import Operator
+    from tf_operator_tpu.runtime import store as store_mod
+
+    op = Operator(backend=None)
+    pod = Pod(metadata=ObjectMeta(
+        name="capjob-worker-0",
+        labels={constants.LABEL_JOB_NAME: "capjob"}))
+    for _ in range(op_mod.MAX_STORED_EVENTS + 10):
+        op.recorder.event(pod, "Normal", "Probe", "x")
+    count = op.store.count(store_mod.EVENTS)
+    assert count <= op_mod.MAX_STORED_EVENTS, count
+    ev = op.store.list(store_mod.EVENTS)[0]
+    assert ev.metadata.labels[constants.LABEL_JOB_NAME] == "capjob"

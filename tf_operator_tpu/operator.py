@@ -28,9 +28,14 @@ PRUNE_BATCH = 512
 log = logging.getLogger("tpu_operator.operator")
 
 
+# Sentinel: "no backend argument" (build the default LocalProcessBackend)
+# vs an explicit backend=None (control plane only, no data plane).
+_DEFAULT_BACKEND = object()
+
+
 class Operator:
     def __init__(self, store: Optional[Store] = None,
-                 backend: Optional[LocalProcessBackend] = None,
+                 backend=_DEFAULT_BACKEND,
                  config: Optional[EngineConfig] = None,
                  namespace: Optional[str] = None,
                  enable_gang_scheduling: bool = False,
@@ -45,7 +50,8 @@ class Operator:
         self.controller = TPUJobController(self.store, recorder=self.recorder,
                                            config=config, gang=gang,
                                            namespace=namespace)
-        self.backend = backend if backend is not None else LocalProcessBackend(self.store)
+        self.backend = (LocalProcessBackend(self.store)
+                        if backend is _DEFAULT_BACKEND else backend)
 
     def start(self, threadiness: int = 2) -> None:
         if self.backend is not None:
