@@ -1,0 +1,84 @@
+"""BERT-base MLM training throughput (BASELINE BERT config payload).
+
+Produced the BERT table in docs/benchmarks.md. Single chip:
+    python benchmarks/bench_bert.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    ap.add_argument("--preset", default="base", choices=["base", "tiny"])
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tf_operator_tpu.models.bert import (
+        Bert,
+        bert_base,
+        bert_tiny,
+        mlm_loss,
+        param_logical_axes,
+    )
+    from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh, use_mesh
+    from tf_operator_tpu.parallel.sharding import LLAMA_RULES
+    from tf_operator_tpu.train.trainer import Trainer
+
+    cfg = bert_base() if args.preset == "base" else bert_tiny(
+        max_seq_len=args.seq)
+    B, S = args.batch, args.seq
+    mesh = make_mesh(MeshConfig(dp=-1))
+    rng = jax.random.PRNGKey(0)
+    data = np.random.default_rng(0)
+    batch = {
+        "inputs": jnp.asarray(data.integers(0, cfg.vocab_size, (B, S)),
+                              jnp.int32),
+        "targets": jnp.asarray(data.integers(0, cfg.vocab_size, (B, S)),
+                               jnp.int32),
+        "mask": jnp.asarray(data.random((B, S)) < 0.15, jnp.float32),
+    }
+    trainer = Trainer(model=Bert(cfg), param_axes_fn=param_logical_axes,
+                      rules=LLAMA_RULES, mesh=mesh,
+                      optimizer=optax.adamw(1e-4), loss_fn=mlm_loss)
+    with use_mesh(mesh):
+        state, sh = trainer.init(rng, batch)
+        step = trainer.make_train_step(sh, batch)
+        for _ in range(3):
+            state, m = step(state, batch)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, m = step(state, batch)
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / args.steps
+
+    nparams = sum(x.size for x in jax.tree.leaves(state.params))
+    print(json.dumps({
+        "what": f"bert_{args.preset}_train",
+        "params": nparams,
+        "ms_per_step": round(dt * 1e3, 1),
+        "tokens_per_sec": round(B * S / dt),
+        "mfu_6nd": round(6 * nparams * B * S / dt
+                         / (args.peak_tflops * 1e12), 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

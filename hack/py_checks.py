@@ -38,12 +38,76 @@ def check_pyflakes() -> bool:
     try:
         import pyflakes  # noqa: F401
     except ImportError:
-        print("py_checks: pyflakes not installed, skipping lint pass")
-        return True
+        print("py_checks: pyflakes not installed, using builtin "
+              "unused-import check")
+        return check_unused_imports()
     targets = [os.path.join(ROOT, d) for d in CHECK_DIRS
                if os.path.isdir(os.path.join(ROOT, d))]
     proc = subprocess.run([sys.executable, "-m", "pyflakes", *targets])
     return proc.returncode == 0
+
+
+def check_unused_imports() -> bool:
+    """Minimal F401 analog: flag imports whose bound name never appears
+    again in the module source. Conservative — `import a.b` binds `a`,
+    star imports and `# noqa` lines are skipped."""
+    import ast
+    import io
+    import tokenize
+
+    ok = True
+    for d in CHECK_DIRS:
+        base = os.path.join(ROOT, d)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, _, files in os.walk(base):
+            if "__pycache__" in dirpath:
+                continue
+            for fn in sorted(files):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                with open(path, "r") as f:
+                    src = f.read()
+                try:
+                    tree = ast.parse(src)
+                except SyntaxError:
+                    continue  # check_compile reports it
+                noqa_lines = set()
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(src).readline):
+                    if tok.type == tokenize.COMMENT and "noqa" in tok.string:
+                        noqa_lines.add(tok.start[0])
+                names = {}
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.Import):
+                        for a in node.names:
+                            bound = (a.asname
+                                     or a.name.split(".")[0])
+                            names[bound] = (node.lineno,
+                                            node.end_lineno or node.lineno)
+                    elif isinstance(node, ast.ImportFrom):
+                        for a in node.names:
+                            if a.name == "*":
+                                continue
+                            names[a.asname or a.name] = (
+                                node.lineno, node.end_lineno or node.lineno)
+                # Attribute accesses hang off a Name node, so collecting
+                # Names alone covers x.y usages too.
+                used = {node.id for node in ast.walk(tree)
+                        if isinstance(node, ast.Name)}
+                for name, (lineno, end) in sorted(names.items(),
+                                                  key=lambda kv: kv[1]):
+                    if name in used or noqa_lines.intersection(
+                            range(lineno, end + 1)):
+                        continue
+                    if name == "annotations":  # from __future__
+                        continue
+                    rel = os.path.relpath(path, ROOT)
+                    print(f"py_checks: unused import '{name}' "
+                          f"at {rel}:{lineno}")
+                    ok = False
+    return ok
 
 
 GENERATED = [

@@ -1,8 +1,7 @@
 """Condition machine tests (reference behavior of util/status.go)."""
 
-import datetime as dt
 
-from tf_operator_tpu.api.types import ConditionStatus, JobConditionType, JobStatus
+from tf_operator_tpu.api.types import JobConditionType, JobStatus
 from tf_operator_tpu.controller import conditions as C
 
 

@@ -5,13 +5,10 @@ import pytest
 from tf_operator_tpu.api import constants, set_defaults, validate_job
 from tf_operator_tpu.api.types import (
     CleanPodPolicy,
-    Container,
     PodSpec,
     PodTemplateSpec,
-    ReplicaSpec,
     RestartPolicy,
     TPUJob,
-    TPUJobSpec,
     ObjectMeta,
 )
 from tf_operator_tpu.api.validation import ValidationError

@@ -506,3 +506,35 @@ def test_sdk_events_visible(operator, client, tmp_path):
     reasons = {e.reason for e in client.get_events("events")}
     assert "SuccessfulCreatePod" in reasons or "Created" in reasons, reasons
     assert client.get_creation_failures("events") == []
+
+
+def test_scale_up_live_job_elastic_env(operator, client, tmp_path):
+    """Dynamic scale-up on a running elastic job: new indices appear and
+    the new pod's sparse cluster spec names only itself (+ ps), the
+    enableDynamicWorker contract (reference tensorflow.go:64-83)."""
+    stub_dir = str(tmp_path / "stub")
+    job = stub_job("grow", stub_dir, worker=1)
+    job.spec.enable_elastic_worker = True
+    client.create(job)
+    wait_for(lambda: len(client.get_pod_names("grow")) == 1, message="1 pod")
+
+    client.patch("grow", lambda j: setattr(
+        j.spec.replica_specs["worker"], "replicas", 3))
+    wait_for(lambda: len(client.get_pod_names("grow")) == 3,
+             message="scale up to 3 pods")
+
+    def snap_exists():
+        path = os.path.join(stub_dir, "grow-worker-2.env.json")
+        return os.path.exists(path) and path
+    path = wait_for(snap_exists, message="worker-2 env snapshot")
+    with open(path) as f:
+        snap = json.load(f)
+    cluster = json.loads(snap["TPUJOB_CLUSTER_SPEC"])
+    # sparse: the worker entry carries only this replica's own address
+    assert len(cluster["cluster"]["worker"]) == 1
+    assert cluster["task"] == {"type": "worker", "index": 2}
+
+    for i in range(3):
+        tell(stub_dir, f"grow-worker-{i}", "exit:0")
+    job = client.wait_for_job("grow", timeout=15)
+    assert testutil.check_condition(job, JobConditionType.SUCCEEDED)

@@ -19,7 +19,7 @@ from tf_operator_tpu.api.types import (
 )
 from tf_operator_tpu.controller import conditions as cond
 from tf_operator_tpu.controller.control import FakeEndpointControl, FakePodControl
-from tf_operator_tpu.controller.engine import EngineConfig, JobEngine
+from tf_operator_tpu.controller.engine import JobEngine
 from tf_operator_tpu.controller.expectations import expectation_key
 
 
@@ -401,3 +401,35 @@ def test_status_written_only_on_change():
     assert len(plugin.status_writes) == 1
     engine.reconcile_jobs(job)  # no change
     assert len(plugin.status_writes) == 1
+
+
+def test_evaluator_does_not_decide_success():
+    """Reference semantics: the evaluator role never gates job success —
+    worker-0 completion succeeds the job while the evaluator still runs,
+    and a completed evaluator alone does not succeed it."""
+    job = testutil.new_tpujob(worker=2, evaluator=1)
+    pods = [testutil.new_pod(job, "worker", 0, phase=PodPhase.SUCCEEDED),
+            testutil.new_pod(job, "worker", 1, phase=PodPhase.RUNNING),
+            testutil.new_pod(job, "evaluator", 0, phase=PodPhase.RUNNING)]
+    engine, plugin = run_status(job, pods)
+    assert cond.is_succeeded(job.status)
+
+    job2 = testutil.new_tpujob(worker=2, evaluator=1)
+    pods2 = [testutil.new_pod(job2, "worker", 0, phase=PodPhase.RUNNING),
+             testutil.new_pod(job2, "worker", 1, phase=PodPhase.RUNNING),
+             testutil.new_pod(job2, "evaluator", 0,
+                              phase=PodPhase.SUCCEEDED)]
+    engine, plugin = run_status(job2, pods2)
+    assert not cond.is_succeeded(job2.status)
+    assert cond.is_running(job2.status)
+
+
+def test_evaluator_failure_fails_job():
+    """Any replica failure (incl. evaluator) fails the job when not
+    restarting (reference status.go failed>0 branch)."""
+    job = testutil.new_tpujob(worker=2, evaluator=1)
+    pods = [testutil.new_pod(job, "worker", 0, phase=PodPhase.RUNNING),
+            testutil.new_pod(job, "worker", 1, phase=PodPhase.RUNNING),
+            testutil.new_pod(job, "evaluator", 0, phase=PodPhase.FAILED)]
+    engine, plugin = run_status(job, pods)
+    assert cond.is_failed(job.status)

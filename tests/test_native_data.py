@@ -47,7 +47,6 @@ def test_synthetic_iterators_shapes():
 
 
 def test_device_feeder_finite_iterator_raises_stopiteration():
-    import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh

@@ -12,7 +12,6 @@ from __future__ import annotations
 import json
 import logging
 import threading
-import time
 import urllib.request
 
 import pytest
@@ -21,7 +20,7 @@ from tf_operator_tpu.api.types import TPUJob
 from tf_operator_tpu.runtime import metrics as m
 from tf_operator_tpu.runtime.leaderelection import LEASES, LeaderElector
 from tf_operator_tpu.runtime.logconfig import JSONFormatter, logger_for_job
-from tf_operator_tpu.runtime.metrics import Counter, Gauge, Histogram, Registry
+from tf_operator_tpu.runtime.metrics import Registry
 from tf_operator_tpu.runtime.monitoring import MonitoringServer
 from tf_operator_tpu.runtime.store import Store
 

@@ -1,7 +1,6 @@
 """Store (API-server analog) tests."""
 
 import threading
-import time
 
 import pytest
 

@@ -34,7 +34,6 @@ from tf_operator_tpu.api.types import (
     ReplicaType,
     TPUJob,
     gen_general_name,
-    is_chief_or_master,
 )
 from tf_operator_tpu.bootstrap.topology import SliceTopology, parse_accelerator
 

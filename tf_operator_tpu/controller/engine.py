@@ -44,7 +44,6 @@ from tf_operator_tpu.controller import conditions as cond
 from tf_operator_tpu.controller.control import (
     EndpointControl,
     PodControl,
-    controller_owner_ref,
 )
 from tf_operator_tpu.controller.exit_codes import is_retryable_exit_code
 from tf_operator_tpu.controller.expectations import (

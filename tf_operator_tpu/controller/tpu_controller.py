@@ -42,7 +42,7 @@ from tf_operator_tpu.runtime.events import (
     EVENT_TYPE_WARNING,
     Recorder,
 )
-from tf_operator_tpu.runtime.store import ADDED, DELETED, MODIFIED, Store
+from tf_operator_tpu.runtime.store import ADDED, DELETED, Store
 from tf_operator_tpu.runtime.workqueue import RateLimitingQueue, ShutDown
 
 log = logging.getLogger("tpu_operator.controller")

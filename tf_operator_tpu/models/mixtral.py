@@ -14,7 +14,7 @@ Shares the attention stack with the Llama family.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 import flax.linen as nn
 import jax

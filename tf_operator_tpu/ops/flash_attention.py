@@ -28,7 +28,7 @@ HBM is what matters on bandwidth-limited parts.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

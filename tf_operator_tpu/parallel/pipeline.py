@@ -22,7 +22,6 @@ How it maps to hardware:
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable
 
 import jax

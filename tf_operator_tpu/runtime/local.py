@@ -26,7 +26,7 @@ import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from tf_operator_tpu.api.types import (
     ContainerStatus,
@@ -36,7 +36,7 @@ from tf_operator_tpu.api.types import (
     RestartPolicy,
 )
 from tf_operator_tpu.runtime import store as store_mod
-from tf_operator_tpu.runtime.store import ADDED, DELETED, MODIFIED, Store
+from tf_operator_tpu.runtime.store import ADDED, DELETED, Store
 
 log = logging.getLogger("tpu_operator.local_backend")
 

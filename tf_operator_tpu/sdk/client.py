@@ -13,7 +13,7 @@ import time
 from typing import Callable, Dict, List, Optional, Union
 
 from tf_operator_tpu.api import constants
-from tf_operator_tpu.api.types import JobConditionType, Pod, TPUJob
+from tf_operator_tpu.api.types import Pod, TPUJob
 from tf_operator_tpu.controller import conditions as cond
 from tf_operator_tpu.runtime import store as store_mod
 from tf_operator_tpu.runtime.store import EVENTS, Store

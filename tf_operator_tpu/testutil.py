@@ -28,7 +28,6 @@ from tf_operator_tpu.api.types import (
     PodTemplateSpec,
     ReplicaSpec,
     ReplicaType,
-    RestartPolicy,
     TPUJob,
     TPUJobSpec,
     TPUSliceSpec,

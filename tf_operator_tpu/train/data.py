@@ -12,11 +12,10 @@ from __future__ import annotations
 
 import threading
 import queue as queue_mod
-from typing import Callable, Dict, Iterator, Optional
+from typing import Dict, Iterator
 
 import jax
 import numpy as np
-from jax.sharding import NamedSharding
 
 
 class SyntheticLM:
