@@ -538,3 +538,89 @@ def test_scale_up_live_job_elastic_env(operator, client, tmp_path):
         tell(stub_dir, f"grow-worker-{i}", "exit:0")
     job = client.wait_for_job("grow", timeout=15)
     assert testutil.check_condition(job, JobConditionType.SUCCEEDED)
+
+
+def test_leader_failover_completes_job(tmp_path):
+    """Operator HA e2e: two control-plane instances share one store with
+    leader election (reference server.go:168-193 — exactly one of N
+    replicas reconciles); pods run on a separate backend (the kubelet
+    analog). When the leader dies without releasing its lease, the
+    standby takes over after expiry and drives a new job to completion."""
+    from tf_operator_tpu.runtime.leaderelection import LeaderElector
+    from tf_operator_tpu.runtime.local import LocalProcessBackend
+
+    store = store_mod.Store()
+    backend = LocalProcessBackend(
+        store=store, workdir=REPO_ROOT,
+        extra_env={"PYTHONPATH": REPO_ROOT + os.pathsep
+                   + os.environ.get("PYTHONPATH", "")})
+    backend.start()
+    ops = [Operator(store=store, backend=None) for _ in range(2)]
+    electors = []
+    for i, op in enumerate(ops):
+        electors.append(LeaderElector(
+            store, identity=f"op-{i}", lease_duration=4.0,
+            renew_deadline=1.0, retry_period=0.2,
+            on_started_leading=lambda op=op: op.controller.run(
+                threadiness=2)))
+    client = TPUJobClient(store)
+    stub_dir = str(tmp_path / "stub")
+    try:
+        electors[0].start()
+        assert electors[0].wait_until_leading(timeout=5)
+        electors[1].start()
+        client.create(stub_job("ha-1", stub_dir, worker=1,
+                               args=("--exit-after", "0.2")))
+        job = client.wait_for_job("ha-1", timeout=15)
+        assert testutil.check_condition(job, JobConditionType.SUCCEEDED)
+        assert not electors[1].is_leader
+
+        # Crash the leader (no release): stop its controller + thread.
+        electors[0]._stop.set()
+        electors[0]._thread.join(timeout=2)
+        ops[0].controller.stop()
+
+        wait_for(lambda: electors[1].is_leader, timeout=10,
+                 message="standby acquires the lease")
+        client.create(stub_job("ha-2", stub_dir, worker=1,
+                               args=("--exit-after", "0.2")))
+        job = client.wait_for_job("ha-2", timeout=15)
+        assert testutil.check_condition(job, JobConditionType.SUCCEEDED)
+    finally:
+        for e in electors:
+            e.stop()
+        for op in ops:
+            op.controller.stop()
+        backend.stop()
+        store.stop_watchers()
+
+
+def test_backoff_limit_exhaustion_fails_job_e2e(operator, client, tmp_path):
+    """backoffLimit at the e2e level: an OnFailure replica crash-looping
+    in place accumulates container restart counts until the limit, then
+    the job fails (reference PastBackoffLimit, job.go:359-396 — only
+    kubelet-restarted policies count toward the limit)."""
+    stub_dir = str(tmp_path / "stub")
+    job = stub_job("backoff", stub_dir, worker=1,
+                   restart_policy=RestartPolicy.ON_FAILURE,
+                   args=("--exit-after", "0.15", "--exit-code", "1"))
+    job.spec.run_policy.backoff_limit = 2
+    client.create(job)
+    job = client.wait_for_job("backoff", timeout=30)
+    assert testutil.check_condition(job, JobConditionType.FAILED)
+    cond_failed = testutil.get_condition(job, JobConditionType.FAILED)
+    assert "backoff" in (cond_failed.message or "").lower() or \
+           "backoff" in (cond_failed.reason or "").lower()
+
+
+def test_active_deadline_fails_running_job_e2e(operator, client, tmp_path):
+    """activeDeadlineSeconds at the e2e level: a healthy but slow job is
+    failed once the deadline passes and its pods are torn down."""
+    stub_dir = str(tmp_path / "stub")
+    job = stub_job("deadline", stub_dir, worker=1)  # runs until told
+    job.spec.run_policy.active_deadline_seconds = 1
+    client.create(job)
+    job = client.wait_for_job("deadline", timeout=30)
+    assert testutil.check_condition(job, JobConditionType.FAILED)
+    wait_for(lambda: client.get_pod_names("deadline") == [],
+             message="pods torn down after deadline")
