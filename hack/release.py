@@ -59,11 +59,22 @@ def build_dist() -> bool:
     env = dict(os.environ, PYTHONPATH=ROOT)
     try:
         import build  # noqa: F401
+        import setuptools  # noqa: F401
+        # setuptools is importable, so skip build isolation — the
+        # zero-network build image cannot pip-install the backend into
+        # an isolated env.
         cmd = [sys.executable, "-m", "build", "--sdist", "--wheel",
-               "--outdir", "dist"]
+               "--no-isolation", "--outdir", "dist"]
     except ImportError:
-        cmd = [sys.executable, "-m", "pip", "wheel", "--no-deps",
-               "--no-build-isolation", "-w", "dist", "."]
+        try:
+            import build  # noqa: F401
+
+            # No local setuptools: let build isolate (needs network).
+            cmd = [sys.executable, "-m", "build", "--sdist", "--wheel",
+                   "--outdir", "dist"]
+        except ImportError:
+            cmd = [sys.executable, "-m", "pip", "wheel", "--no-deps",
+                   "--no-build-isolation", "-w", "dist", "."]
     print(f"release: {' '.join(cmd)}")
     return subprocess.run(cmd, cwd=ROOT, env=env).returncode == 0
 
