@@ -35,6 +35,10 @@ def main() -> int:
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--head-dim", type=int, default=128)
     ap.add_argument("--peak-tflops", type=float, default=197.0)
+    ap.add_argument("--impl", default="both",
+                    choices=["both", "xla", "flash"],
+                    help="flash-only for long sequences (the XLA path "
+                         "materializes S^2 scores and OOMs past ~8k)")
     args = ap.parse_args()
 
     import jax
@@ -51,7 +55,10 @@ def main() -> int:
                for i in range(3))
     flops = 4 * B * H * S * S * D / 2  # causal
 
-    for name, fn in (("xla", xla_attention), ("flash", flash_attention)):
+    impls = [("xla", xla_attention), ("flash", flash_attention)]
+    if args.impl != "both":
+        impls = [(n, f) for n, f in impls if n == args.impl]
+    for name, fn in impls:
         fwd = jax.jit(lambda q, k, v, f=fn:
                       f(q, k, v, causal=True).astype(jnp.float32).sum())
         dt = timeit(fwd, q, k, v)
