@@ -57,7 +57,8 @@ def bench_resnet50(batch_size: int, image_size: int, steps: int,
     trainer = Trainer(model=rn.ResNet(cfg), param_axes_fn=rn.param_logical_axes,
                       rules=CNN_RULES, mesh=mesh,
                       optimizer=optax.sgd(0.1, momentum=0.9),
-                      loss_fn=classification_loss)
+                      loss_fn=classification_loss,
+                      grad_norm_metric=False)
     rng = jax.random.PRNGKey(0)
     batch = rn.synthetic_batch(rng, batch_size=batch_size,
                                image_size=image_size)

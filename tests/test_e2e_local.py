@@ -624,3 +624,34 @@ def test_active_deadline_fails_running_job_e2e(operator, client, tmp_path):
     assert testutil.check_condition(job, JobConditionType.FAILED)
     wait_for(lambda: client.get_pod_names("deadline") == [],
              message="pods torn down after deadline")
+
+
+def test_gang_multislice_capacity_accounting(tmp_path):
+    """Multislice gangs claim num_slices x slice chips: a 2-slice v5e-8
+    job (16 chips) fills a 16-chip pool, gating a single-slice job until
+    the multislice gang completes."""
+    op = Operator.local(workdir=REPO_ROOT, enable_gang_scheduling=True,
+                        total_chips=16)
+    op.start(threadiness=2)
+    try:
+        client = TPUJobClient(op.store)
+        stub_dir = str(tmp_path / "stub")
+        multi = stub_job("ms-a", stub_dir, worker=2, accelerator="v5e-8")
+        multi.spec.slice.num_slices = 2
+        client.create(multi)
+        client.wait_for_condition("ms-a", JobConditionType.RUNNING,
+                                  timeout=10)
+        client.create(stub_job("ms-b", stub_dir, worker=1,
+                               accelerator="v5e-8",
+                               args=("--exit-after", "0.3")))
+        time.sleep(0.6)
+        pods_b = client.get_pods("ms-b")
+        assert pods_b and all(p.status.phase == "Pending" for p in pods_b), \
+            "ms-b must wait while the multislice gang holds all 16 chips"
+        for i in range(2):
+            tell(stub_dir, f"ms-a-worker-{i}", "exit:0")
+        client.wait_for_job("ms-a", timeout=15)
+        job_b = client.wait_for_job("ms-b", timeout=15)
+        assert testutil.check_condition(job_b, JobConditionType.SUCCEEDED)
+    finally:
+        op.stop()

@@ -99,6 +99,9 @@ class Trainer:
     # (params, extra_vars, batch, model_apply) -> (loss, new_extra_vars)
     loss_fn: Callable = None
     model_inputs_fn: Callable = None  # batch -> model.init args
+    # grad_norm in step metrics costs a full gradient read per step —
+    # noticeable on bandwidth-limited parts; benchmarks turn it off.
+    grad_norm_metric: bool = True
 
     def __post_init__(self):
         if self.loss_fn is None:
@@ -175,9 +178,10 @@ class Trainer:
             new_params = optax.apply_updates(state.params, updates)
             metrics = {
                 "loss": loss,
-                "grad_norm": optax.global_norm(grads),
                 "step": state.step,
             }
+            if self.grad_norm_metric:
+                metrics["grad_norm"] = optax.global_norm(grads)
             return TrainState(step=state.step + 1, params=new_params,
                               opt_state=new_opt,
                               extra_vars=new_extra), metrics
