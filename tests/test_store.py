@@ -102,3 +102,16 @@ def test_mutating_returned_object_does_not_affect_store():
     created.spec.replica_specs["worker"].replicas = 42
     stored = s.get(store_mod.TPUJOBS, "default", created.metadata.name)
     assert stored.spec.replica_specs["worker"].replicas == 1
+
+
+def test_keys_returns_metadata_without_payload_copy():
+    store = Store()
+    for i in range(3):
+        store.create(store_mod.TPUJOBS,
+                     testutil.new_tpujob(worker=1, name=f"j{i}"))
+    ks = store.keys(store_mod.TPUJOBS)
+    assert len(ks) == 3
+    assert {name for _, name, _ in ks} == {"j0", "j1", "j2"}
+    rvs = [rv for _, _, rv in ks]
+    assert all(isinstance(rv, int) for rv in rvs)
+    assert len(set(rvs)) == 3  # monotone resourceVersions, usable for age sort

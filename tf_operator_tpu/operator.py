@@ -75,11 +75,12 @@ class Operator:
         try:
             self.store.create(EVENTS, record)
             if self.store.count(EVENTS) > MAX_STORED_EVENTS:
-                stale = sorted(self.store.list(EVENTS),
-                               key=lambda e: e.metadata.resource_version)
-                for old in stale[:PRUNE_BATCH]:
-                    self.store.try_delete(EVENTS, old.metadata.namespace,
-                                          old.metadata.name)
+                # Prune by key metadata only — list() would deepcopy all
+                # ~4096 event payloads inside the recorder's synchronous
+                # sink while reconcile threads block on it.
+                stale = sorted(self.store.keys(EVENTS), key=lambda t: t[2])
+                for ns, name, _ in stale[:PRUNE_BATCH]:
+                    self.store.try_delete(EVENTS, ns, name)
         except Exception:
             log.debug("event persist failed", exc_info=True)
 

@@ -334,6 +334,11 @@ def flash_supported(q_seq: int, k_seq: int, head_dim: int,
     """Shapes must tile into sublane-aligned blocks; head_dim must fill
     MXU lanes."""
     bq, bk = _fit_block(q_seq, block_q), _fit_block(k_seq, block_k)
+    if bq < 8 or bk < 8:
+        # Degenerate sequences (< 8, e.g. single-token decode) cannot
+        # form a sublane-aligned block — fall back instead of dividing
+        # by the zero block _fit_block returns.
+        return False
     return (q_seq % bq == 0 and bq % 8 == 0
             and k_seq % bk == 0 and bk % 8 == 0
             and head_dim % _LANES == 0 and head_dim <= 512)
@@ -405,13 +410,18 @@ def best_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     falling back."""
     from tf_operator_tpu.ops.layers import attention, repeat_kv
 
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"GQA head counts must divide: q heads {q.shape[2]}, "
+            f"kv heads {k.shape[2]}")
     sp_size = 1 if mesh is None else mesh.shape.get("sp", 1)
     tp_size = 1 if mesh is None else mesh.shape.get("tp", 1)
     # Under a mesh the head axis of q AND k/v is sharded over tp, so
-    # unrepeated GQA KV must still divide tp (llama_3_8b kv=8, tp=16
-    # would otherwise crash in shard_map instead of falling back).
+    # both head counts must divide tp for the shard_map specs to be
+    # legal (llama_3_8b kv=8, tp=16 would otherwise crash in shard_map
+    # instead of falling back).
     auto_ok = (on_tpu() and sp_size == 1
-               and q.shape[2] % k.shape[2] == 0
+               and q.shape[2] % tp_size == 0
                and k.shape[2] % tp_size == 0
                and flash_supported(q.shape[1], k.shape[1], q.shape[3]))
     if force_flash or auto_ok:

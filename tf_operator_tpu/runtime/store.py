@@ -187,6 +187,13 @@ class Store:
         with self._lock:
             return len(self._objects.get(kind, {}))
 
+    def keys(self, kind: str) -> List[Tuple[str, str, int]]:
+        """(namespace, name, resourceVersion) tuples without deepcopying
+        payloads — for pruning/housekeeping over large collections."""
+        with self._lock:
+            return [(ns, name, obj.metadata.resource_version)
+                    for (ns, name), obj in self._objects.get(kind, {}).items()]
+
     # -- watch ------------------------------------------------------------
 
     def watch(self, kind: str,
@@ -228,3 +235,4 @@ PODS = "pods"
 ENDPOINTS = "endpoints"
 SLICEGROUPS = "slicegroups"
 EVENTS = "events"
+NODES = "nodes"
