@@ -177,6 +177,10 @@ class PodSpec(ApiObject):
     restart_policy: str = RestartPolicy.NEVER
     scheduler_name: str = ""
     node_selector: Dict[str, str] = field(default_factory=dict)
+    # Which node agent runs this pod. Empty = unscheduled; agents claim
+    # pending pods by CAS-ing their own name in (pull scheduling — the
+    # kube-scheduler binding analog for the served control plane).
+    node_name: str = ""
 
     def container(self, name: str) -> Optional[Container]:
         for c in self.containers:
@@ -204,6 +208,11 @@ class PodStatus(ApiObject):
     # Where the runtime captured this pod's combined stdout/stderr (the
     # kubelet-log analog the SDK's get_logs reads).
     log_path: str = ""
+    # Host ports the running node allocated for this pod (name -> port);
+    # "coordinator" is the jax.distributed rendezvous port. Peers resolve
+    # cluster DNS names to (status.host, status.ports[...]) through the
+    # control plane instead of kube-dns.
+    ports: Dict[str, int] = field(default_factory=dict)
 
     def container_status(self, name: str) -> Optional[ContainerStatus]:
         for cs in self.container_statuses:
@@ -397,6 +406,38 @@ class SliceGroup(ApiObject):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: SliceGroupSpec = field(default_factory=SliceGroupSpec)
     status: SliceGroupStatus = field(default_factory=SliceGroupStatus)
+
+
+# ---------------------------------------------------------------------------
+# Node: a host registered with the served control plane (kubelet-node
+# analog). Agents self-register, heartbeat, and claim pending pods.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class NodeSpec(ApiObject):
+    # Address peers dial to reach pods on this node (TPU worker host IP).
+    address: str = "127.0.0.1"
+    # Chip capacity this node contributes to gang admission accounting.
+    chips: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class NodeStatus(ApiObject):
+    phase: str = "Ready"
+    last_heartbeat: Optional[_dt.datetime] = None
+    # Base URL of the node agent's log server; the API server proxies
+    # pod-log reads here (kubelet log API analog).
+    log_url: str = ""
+
+
+@dataclasses.dataclass
+class Node(ApiObject):
+    api_version: str = "v1"
+    kind: str = "Node"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
 
 
 def gen_general_name(job_name: str, rtype: str, index: int) -> str:

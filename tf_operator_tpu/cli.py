@@ -65,6 +65,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="port for /metrics, /healthz "
                         "(0 = disabled, -1 = ephemeral)")
     p.add_argument("--monitoring-host", default="127.0.0.1")
+    p.add_argument("--api-port", type=int, default=0,
+                   help="serve the control-plane API on this port "
+                        "(0 = disabled, -1 = ephemeral); remote SDK "
+                        "clients and node agents connect here")
+    p.add_argument("--api-host", default="127.0.0.1",
+                   help="bind address for the control-plane API")
+    p.add_argument("--backend", choices=("local", "none"), default="local",
+                   help="data plane: 'local' runs pods as subprocesses "
+                        "in this process; 'none' leaves pods to external "
+                        "node agents (requires --api-port)")
     p.add_argument("--resync-period", type=float, default=30.0,
                    help="idle full re-enqueue period in seconds (0 = off)")
     p.add_argument("--leader-elect", default=True,
@@ -87,11 +97,22 @@ class Server:
         # thread, never on the elector's own thread.
         self.on_fatal = on_fatal
         self.store = store or store_mod.Store()
+        op_kwargs = {}
+        if getattr(args, "backend", "local") == "none":
+            op_kwargs["backend"] = None
         self.operator = Operator(
             store=self.store,
             namespace=args.namespace or None,
             enable_gang_scheduling=args.enable_gang_scheduling,
-            total_chips=args.total_chips)
+            total_chips=args.total_chips,
+            **op_kwargs)
+        self.api_server = None
+        if getattr(args, "api_port", 0) != 0:
+            from tf_operator_tpu.runtime.apiserver import APIServer
+
+            self.api_server = APIServer(self.store,
+                                        host=args.api_host,
+                                        port=max(args.api_port, 0))
         self.monitoring: Optional[MonitoringServer] = None
         if args.monitoring_port != 0:
             self.monitoring = MonitoringServer(
@@ -143,6 +164,12 @@ class Server:
                 self.operator.controller.enqueue(job.key())
 
     def start(self) -> None:
+        if self.api_server is not None:
+            # The API serves reads/writes even before this replica leads
+            # (the reference API server is always up; leadership only
+            # gates reconciling).
+            self.api_server.start()
+            log.info("control-plane API on %s", self.api_server.url)
         if self.monitoring is not None:
             self.monitoring.start()
         if self.elector is not None:
@@ -155,12 +182,19 @@ class Server:
         if self.elector is not None:
             self.elector.stop()
         self.operator.stop()
+        if self.api_server is not None:
+            self.api_server.stop()
         if self.monitoring is not None:
             self.monitoring.stop()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.backend == "none" and args.api_port == 0:
+        parser.error("--backend none needs --api-port: without a served "
+                     "API no node agent can reach the control plane, so "
+                     "pods would sit Pending forever")
     if args.version:
         print(version_string())
         return 0

@@ -8,11 +8,13 @@ exit codes) back to the store, driving the controller's watch feedback
 loop. Pod-level restartPolicy (Always/OnFailure) is honored in-place with
 restart counts, which feeds the engine's PastBackoffLimit policy.
 
-Single-host service discovery: env rendered by the bootstrap layer uses
-cluster DNS names; ``_localize_env`` rewrites them to 127.0.0.1 with a
-per-job coordinator port so real multi-process jax.distributed jobs can
-rendezvous locally. Cluster backends (GKE) would resolve the same names
-via per-replica headless services instead.
+Service discovery is pluggable: env rendered by the bootstrap layer uses
+cluster DNS names; the ``resolver`` rewrites them to reachable addresses
+at spawn time. The default ``LoopbackEnvResolver`` maps everything to
+127.0.0.1 with a per-job coordinator port (hermetic single-host runs);
+node agents use ``agent.ControlPlaneEnvResolver``, which resolves names
+through pod placement records in the served control plane (kube-dns
+analog). A ``pod_filter`` scopes the backend to pods bound to one node.
 """
 
 from __future__ import annotations
@@ -36,7 +38,7 @@ from tf_operator_tpu.api.types import (
     RestartPolicy,
 )
 from tf_operator_tpu.runtime import store as store_mod
-from tf_operator_tpu.runtime.store import ADDED, DELETED, Store
+from tf_operator_tpu.runtime.store import ADDED, DELETED, MODIFIED, Store
 
 log = logging.getLogger("tpu_operator.local_backend")
 
@@ -58,20 +60,57 @@ class _RunningPod:
     done: bool = False
 
 
+class LoopbackEnvResolver:
+    """Single-host resolution: rewrite cluster DNS names to 127.0.0.1
+    with one free coordinator port per job. The hermetic default; served
+    deployments use the agent's control-plane resolver instead."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._job_ports: Dict[str, int] = {}  # job uid -> coord port
+
+    def resolve(self, pod: Pod, env: Dict[str, str]) -> Dict[str, str]:
+        job_uid = ""
+        ref = pod.metadata.controller_ref()
+        if ref is not None:
+            job_uid = ref.uid
+        with self._lock:
+            port = self._job_ports.get(job_uid)
+            if port is None:
+                port = _free_port()
+                self._job_ports[job_uid] = port
+        out = {}
+        for k, v in env.items():
+            if k in ("JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS"):
+                out[k] = f"127.0.0.1:{port}"
+            elif k == "TPU_WORKER_HOSTNAMES":
+                out[k] = ",".join("127.0.0.1" for _ in v.split(","))
+            else:
+                out[k] = v
+        return out
+
+
 class LocalProcessBackend:
     def __init__(self, store: Store, workdir: Optional[str] = None,
                  extra_env: Optional[Dict[str, str]] = None,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 resolver=None,
+                 pod_filter=None):
         self.store = store
         self.workdir = workdir or os.getcwd()
         self.extra_env = dict(extra_env or {})
+        # Service-discovery strategy: rewrites bootstrap env (coordinator
+        # address, worker hostnames) to reachable addresses at spawn time.
+        self.resolver = resolver or LoopbackEnvResolver()
+        # Which pods this backend runs (a node agent passes "pods bound
+        # to me"); None = every pod in the store.
+        self.pod_filter = pod_filter
         # Pod stdout/stderr capture (kubelet container-log analog);
         # surfaced to clients via pod.status.log_path.
         self.log_dir = log_dir or os.path.join(
             tempfile.gettempdir(), f"tpujob-logs-{os.getpid()}")
         self._lock = threading.Lock()
         self._running: Dict[str, _RunningPod] = {}  # "ns/name" -> state
-        self._job_ports: Dict[str, int] = {}        # job uid -> coord port
         self._watcher = None
         self._stopped = False
 
@@ -93,7 +132,14 @@ class LocalProcessBackend:
         if self._stopped:
             return
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
-        if event_type == ADDED:
+        # MODIFIED starts pods too: a node agent's claim (binding
+        # spec.node_name) arrives as MODIFIED, and the _running dedup
+        # makes re-delivery harmless.
+        if event_type in (ADDED, MODIFIED):
+            if self.pod_filter is not None and not self.pod_filter(pod):
+                return
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                return  # terminal status echoes (incl. our own writes)
             with self._lock:
                 if key in self._running:
                     return
@@ -174,7 +220,7 @@ class LocalProcessBackend:
         for var in ("PYTHONPATH", "HOME", "LANG"):
             if var in os.environ:
                 env.setdefault(var, os.environ[var])
-        env.update(self._localize_env(pod, container.env))
+        env.update(self.resolver.resolve(pod, container.env))
         env["TPUJOB_POD_NAME"] = pod.metadata.name
         env["TPUJOB_POD_NAMESPACE"] = pod.metadata.namespace
         os.makedirs(self.log_dir, exist_ok=True)
@@ -197,27 +243,6 @@ class LocalProcessBackend:
         return os.path.join(
             self.log_dir,
             f"{pod.metadata.namespace}.{pod.metadata.name}.{uid}.log")
-
-    def _localize_env(self, pod: Pod, env: Dict[str, str]) -> Dict[str, str]:
-        """Rewrite cluster DNS names to 127.0.0.1 for single-host runs."""
-        job_uid = ""
-        ref = pod.metadata.controller_ref()
-        if ref is not None:
-            job_uid = ref.uid
-        with self._lock:
-            port = self._job_ports.get(job_uid)
-            if port is None:
-                port = _free_port()
-                self._job_ports[job_uid] = port
-        out = {}
-        for k, v in env.items():
-            if k in ("JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS"):
-                out[k] = f"127.0.0.1:{port}"
-            elif k == "TPU_WORKER_HOSTNAMES":
-                out[k] = ",".join("127.0.0.1" for _ in v.split(","))
-            else:
-                out[k] = v
-        return out
 
     # ------------------------------------------------------------------
 
@@ -318,6 +343,12 @@ class LocalProcessBackend:
         log_path = self.pod_log_path(pod)
         if os.path.exists(log_path):
             status.log_path = log_path
+        # Preserve the placement the claiming agent published — peers
+        # resolve coordinator addresses from these fields.
+        if stored.status.host:
+            status.host = stored.status.host
+        if stored.status.ports:
+            status.ports = dict(stored.status.ports)
         stored.status = status
         try:
             self.store.update_status(store_mod.PODS, stored)

@@ -1,0 +1,265 @@
+"""RemoteStore: the Store interface over the served API.
+
+The reference's clientsets speak to a remote API server from any process
+(app/server.go:198-229; the SDK from anywhere,
+api/tf_job_client.py:55-100). RemoteStore is that client: it duck-types
+the in-process Store (create/get/list/update/update_status/delete/watch
+and friends), so the SDK, node agents, and the engine's controls run
+unchanged against an operator in another process or on another host.
+
+Watch is a streaming GET of JSON lines; on connection loss the watcher
+reconnects and the server replays current objects as ADDED — the informer
+relist contract, which every consumer in this codebase already treats as
+idempotent.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.apiserver import WIRE_KINDS
+
+log = logging.getLogger("tpu_operator.remote")
+
+_RECONNECT_DELAY = 0.5
+
+
+class RemoteWatcher:
+    """Store.Watcher analog over a streaming HTTP connection."""
+
+    def __init__(self, base_url: str, kind: str,
+                 handler: Callable[[str, object], None],
+                 namespace: Optional[str] = None):
+        self._url = f"{base_url}/apis/v1/watch/{kind}"
+        if namespace is not None:
+            self._url += "?" + urllib.parse.urlencode(
+                {"namespace": namespace})
+        self.kind = kind
+        self.handler = handler
+        self._stopped = threading.Event()
+        self._resp = None
+        self._lock = threading.Lock()
+        self.thread = threading.Thread(target=self._loop,
+                                       name=f"watch-{kind}", daemon=True)
+        self.thread.start()
+
+    def _loop(self) -> None:
+        cls = WIRE_KINDS[self.kind]
+        while not self._stopped.is_set():
+            try:
+                resp = urllib.request.urlopen(self._url)
+                with self._lock:
+                    if self._stopped.is_set():
+                        resp.close()
+                        return
+                    self._resp = resp
+                for raw in resp:
+                    if self._stopped.is_set():
+                        return
+                    raw = raw.strip()
+                    if not raw:
+                        continue  # keepalive
+                    evt = json.loads(raw)
+                    obj = cls.from_dict(evt["object"])
+                    try:
+                        self.handler(evt["type"], obj)
+                    except Exception:
+                        log.exception("watch handler error for %s", self.kind)
+            except (OSError, urllib.error.URLError, ValueError,
+                    AttributeError):
+                # AttributeError: stop() closed the response from another
+                # thread mid-read; http.client's internals race their own
+                # teardown. Treat like any disconnect.
+                if self._stopped.is_set():
+                    return
+                log.debug("watch %s disconnected; reconnecting", self.kind)
+            finally:
+                with self._lock:
+                    if self._resp is not None:
+                        try:
+                            self._resp.close()
+                        except Exception:
+                            pass
+                        self._resp = None
+            self._stopped.wait(_RECONNECT_DELAY)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._lock:
+            if self._resp is not None:
+                try:
+                    # Closing the socket unblocks the reader thread.
+                    self._resp.close()
+                except Exception:
+                    pass
+        self.thread.join(timeout=5)
+
+
+class RemoteStore:
+    """HTTP client with the Store's surface."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._watchers: List[RemoteWatcher] = []
+        self._lock = threading.Lock()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None,
+                 query: Optional[Dict[str, str]] = None) -> dict:
+        url = f"{self.base_url}{path}"
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = None
+        headers = {}
+        if body is not None:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            payload = {}
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except (ValueError, OSError):
+                pass
+            reason = payload.get("reason", "")
+            message = payload.get("message", str(e))
+            if reason == "NotFound":
+                raise store_mod.NotFoundError(message)
+            if reason == "AlreadyExists":
+                raise store_mod.AlreadyExistsError(message)
+            if reason == "Conflict":
+                raise store_mod.ConflictError(message)
+            raise RuntimeError(f"API error {e.code}: {message}")
+
+    @staticmethod
+    def _cls(kind: str):
+        cls = WIRE_KINDS.get(kind)
+        if cls is None:
+            raise KeyError(f"unknown kind {kind!r}")
+        return cls
+
+    # -- CRUD (Store surface) ---------------------------------------------
+
+    def create(self, kind: str, obj) -> object:
+        data = self._request("POST", f"/apis/v1/{kind}", body=obj.to_dict())
+        return self._cls(kind).from_dict(data)
+
+    def get(self, kind: str, namespace: str, name: str) -> object:
+        data = self._request("GET", f"/apis/v1/{kind}/{namespace}/{name}")
+        return self._cls(kind).from_dict(data)
+
+    def try_get(self, kind: str, namespace: str, name: str):
+        try:
+            return self.get(kind, namespace, name)
+        except store_mod.NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             selector: Optional[Dict[str, str]] = None) -> List[object]:
+        query: Dict[str, str] = {}
+        if namespace is not None:
+            query["namespace"] = namespace
+        if selector:
+            query["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in sorted(selector.items()))
+        data = self._request("GET", f"/apis/v1/{kind}", query=query)
+        cls = self._cls(kind)
+        return [cls.from_dict(item) for item in data.get("items", [])]
+
+    def update(self, kind: str, obj) -> object:
+        meta = obj.metadata
+        data = self._request(
+            "PUT", f"/apis/v1/{kind}/{meta.namespace}/{meta.name}",
+            body=obj.to_dict())
+        return self._cls(kind).from_dict(data)
+
+    def update_status(self, kind: str, obj) -> object:
+        meta = obj.metadata
+        data = self._request(
+            "PUT", f"/apis/v1/{kind}/{meta.namespace}/{meta.name}/status",
+            body=obj.to_dict())
+        return self._cls(kind).from_dict(data)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._request("DELETE", f"/apis/v1/{kind}/{namespace}/{name}")
+
+    def try_delete(self, kind: str, namespace: str, name: str) -> bool:
+        try:
+            self.delete(kind, namespace, name)
+            return True
+        except store_mod.NotFoundError:
+            return False
+
+    def count(self, kind: str) -> int:
+        return len(self.list(kind))
+
+    def keys(self, kind: str) -> List[Tuple[str, str, int]]:
+        return [(o.metadata.namespace, o.metadata.name,
+                 o.metadata.resource_version) for o in self.list(kind)]
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, kind: str, handler: Callable[[str, object], None],
+              replay: bool = True) -> RemoteWatcher:
+        # The server always replays current objects as ADDED on
+        # (re)connect; the replay flag exists for signature parity.
+        self._cls(kind)
+        w = RemoteWatcher(self.base_url, kind, handler)
+        with self._lock:
+            self._watchers.append(w)
+        return w
+
+    def stop_watchers(self) -> None:
+        with self._lock:
+            watchers, self._watchers = self._watchers, []
+        for w in watchers:
+            w.stop()
+
+    # -- logs (API-server log proxy; not part of the in-process Store) ----
+
+    def read_logs(self, namespace: str, pod_name: str,
+                  tail_lines: Optional[int] = None) -> str:
+        query: Dict[str, str] = {}
+        if tail_lines is not None:
+            query["tailLines"] = str(tail_lines)
+        url = f"{self.base_url}/logs/{namespace}/{pod_name}"
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+                return resp.read().decode(errors="replace")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return ""
+            raise
+
+    def stream_logs(self, namespace: str, pod_name: str
+                    ) -> Iterator[str]:
+        """Follow a pod's log live (kubectl logs -f analog): yields chunks
+        until the stream ends (pod finished and log drained). No socket
+        timeout: a training pod can be quiet for minutes between lines;
+        the server closes the stream when the pod terminates."""
+        url = (f"{self.base_url}/logs/{namespace}/{pod_name}?follow=1")
+        resp = urllib.request.urlopen(url, timeout=None)
+        try:
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    return
+                yield chunk.decode(errors="replace")
+        finally:
+            resp.close()

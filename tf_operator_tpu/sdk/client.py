@@ -28,6 +28,19 @@ class TPUJobClient:
         self.store = store
         self.namespace = namespace
 
+    @classmethod
+    def connect(cls, server_url: str,
+                namespace: str = "default") -> "TPUJobClient":
+        """Client against a served control plane (reference: TFJobClient
+        building a kubernetes client from kubeconfig and talking HTTPS,
+        tf_job_client.py:55-100). Works from any process or host:
+
+            client = TPUJobClient.connect("http://operator-host:8080")
+        """
+        from tf_operator_tpu.runtime.remote import RemoteStore
+
+        return cls(RemoteStore(server_url), namespace=namespace)
+
     # -- CRUD (reference tf_job_client.py:77-222) -----------------------
 
     def create(self, job: Union[TPUJob, dict],
@@ -184,9 +197,14 @@ class TPUJobClient:
     def get_logs(self, pod_name: str, namespace: Optional[str] = None,
                  tail_lines: Optional[int] = None) -> str:
         """One pod's captured stdout/stderr (reference
-        tf_job_client.py:380-446 read_namespaced_pod_log analog)."""
-        pod = self.store.try_get(store_mod.PODS,
-                                 namespace or self.namespace, pod_name)
+        tf_job_client.py:380-446 read_namespaced_pod_log analog). Against
+        a served control plane this reads through the API server's log
+        proxy (kubelet log API); in-process it reads the local file."""
+        ns = namespace or self.namespace
+        read_remote = getattr(self.store, "read_logs", None)
+        if read_remote is not None:
+            return read_remote(ns, pod_name, tail_lines=tail_lines)
+        pod = self.store.try_get(store_mod.PODS, ns, pod_name)
         if pod is None or not pod.status.log_path:
             return ""
         try:
@@ -198,6 +216,95 @@ class TPUJobClient:
             lines = text.splitlines()[-tail_lines:] if tail_lines > 0 else []
             text = "\n".join(lines)
         return text
+
+    def stream_logs(self, pod_name: str, namespace: Optional[str] = None):
+        """Follow one pod's log live until it reaches a terminal phase
+        (kubectl logs -f). Yields text chunks."""
+        import os as _os
+
+        ns = namespace or self.namespace
+        remote = getattr(self.store, "stream_logs", None)
+        if remote is not None:
+            yield from remote(ns, pod_name)
+            return
+        pos = 0
+        while True:
+            pod = self.store.try_get(store_mod.PODS, ns, pod_name)
+            path = pod.status.log_path if pod is not None else ""
+            chunk = b""
+            if path and _os.path.exists(path):
+                # Binary reads with byte offsets: a text-mode seek with a
+                # character count lands mid-codepoint on non-ASCII logs.
+                with open(path, "rb") as f:
+                    f.seek(pos)
+                    chunk = f.read()
+            if chunk:
+                pos += len(chunk)
+                yield chunk.decode(errors="replace")
+                continue
+            from tf_operator_tpu.api.types import PodPhase
+
+            if pod is None or pod.status.phase in (PodPhase.SUCCEEDED,
+                                                   PodPhase.FAILED):
+                return
+            time.sleep(0.05)
+
+    def follow_job_logs(self, name: str, namespace: Optional[str] = None,
+                        replica_type: Optional[str] = None,
+                        timeout: Optional[float] = None):
+        """Interleaved live tail across every pod of a job (the reference
+        SDK's multi-pod follow, tf_job_client.py:380-446: one thread +
+        queue per pod). Yields ``(pod_name, chunk)`` until every pod's
+        stream ends or ``timeout`` elapses."""
+        import queue as _queue
+        import threading as _threading
+
+        pods = self.get_pod_names(name, namespace=namespace,
+                                  replica_type=replica_type)
+        # Bounded queue + stop flag: when the consumer stops (timeout or
+        # generator close), pumps must not keep accumulating chunks
+        # forever for a still-running job.
+        q: "_queue.Queue" = _queue.Queue(maxsize=256)
+        stop = _threading.Event()
+        done = object()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def pump(pod_name: str) -> None:
+            try:
+                for chunk in self.stream_logs(pod_name, namespace=namespace):
+                    if not put((pod_name, chunk)):
+                        return
+            finally:
+                put((pod_name, done))
+
+        threads = [_threading.Thread(target=pump, args=(p,), daemon=True)
+                   for p in pods]
+        for t in threads:
+            t.start()
+        live = set(pods)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            while live:
+                remaining = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                try:
+                    pod_name, chunk = q.get(timeout=remaining)
+                except _queue.Empty:
+                    return
+                if chunk is done:
+                    live.discard(pod_name)
+                    continue
+                yield pod_name, chunk
+        finally:
+            stop.set()
 
     def get_job_logs(self, name: str, namespace: Optional[str] = None,
                      replica_type: Optional[str] = None,
