@@ -1,0 +1,186 @@
+"""ResNet-50 normalization-scheme experiment (round-2 verdict item #3).
+
+The round-1 platform characterization (BASELINE.md) showed the bench
+chip's VPU/reduce ceiling (~21-27 G elem/s) makes BatchNorm statistics
+the dominant step cost (47 of 99 ms). This benchmark runs the
+"different normalization scheme" experiments that analysis pointed at,
+measuring for each variant:
+
+- images/sec (median of 3 timed reps, spread reported), and
+- a loss-curve accuracy proxy: training loss trajectory over >=100
+  steps on a fixed synthetic stream, compared against the f32-BN
+  baseline curve.
+
+Variants:
+    bn           f32-statistics batch norm (baseline)
+    bn_bf16      bf16-statistics accumulation (halves convert traffic)
+    group        GroupNorm(32) — no batch statistics across samples
+    bn_every_4   interval statistics: 1 stats step, 3 frozen-stats steps
+    affine       per-channel scale/bias only — NOT a training scheme;
+                 upper-bound probe for norm-free formulations
+
+    python benchmarks/bench_norm.py --steps 20 --loss-steps 120
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build(variant: str, batch_size: int, image_size: int,
+          tiny: bool = False):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tf_operator_tpu.models import resnet as rn
+    from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh
+    from tf_operator_tpu.parallel.sharding import CNN_RULES
+    from tf_operator_tpu.train.trainer import (
+        Trainer,
+        classification_loss,
+        classification_loss_frozen_stats,
+    )
+
+    norm = {"bn": "bn", "bn_bf16": "bn_bf16", "group": "group",
+            "bn_every_4": "bn", "affine": "affine"}[variant]
+    import dataclasses as _dc
+
+    base_cfg = rn.resnet_tiny() if tiny else rn.resnet50()
+    cfg = _dc.replace(base_cfg, norm=norm)
+    mesh = make_mesh(MeshConfig(dp=-1), devices=jax.devices()[:1])
+
+    def make_trainer(loss_fn):
+        return Trainer(model=rn.ResNet(cfg),
+                       param_axes_fn=rn.param_logical_axes,
+                       rules=CNN_RULES, mesh=mesh,
+                       optimizer=optax.sgd(0.1, momentum=0.9),
+                       loss_fn=loss_fn, grad_norm_metric=False)
+
+    trainer = make_trainer(classification_loss)
+    rng = jax.random.PRNGKey(0)
+    batch = rn.synthetic_batch(rng, batch_size=batch_size,
+                               image_size=image_size,
+                               num_classes=cfg.num_classes)
+    batch["inputs"] = batch["inputs"].astype(jnp.bfloat16)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()}
+    state, shardings = trainer.init(rng, batch)
+    stats_step = trainer.make_train_step(shardings, batch)
+    frozen_step = None
+    if variant == "bn_every_4":
+        frozen_step = make_trainer(
+            classification_loss_frozen_stats).make_train_step(
+                shardings, batch)
+    return state, batch, stats_step, frozen_step
+
+
+def step_schedule(variant: str, stats_step, frozen_step):
+    """Per-step callable sequence for one macro-cycle of the variant."""
+    if variant == "bn_every_4":
+        return [stats_step, frozen_step, frozen_step, frozen_step]
+    return [stats_step]
+
+
+def run_variant(variant: str, batch_size: int, image_size: int,
+                steps: int, loss_steps: int, loss_every: int,
+                tiny: bool = False):
+    import jax
+
+    num_classes = 10 if tiny else 1000
+    state, batch, stats_step, frozen_step = build(variant, batch_size,
+                                                  image_size, tiny)
+    cycle = step_schedule(variant, stats_step, frozen_step)
+
+    # Warmup both compiled paths.
+    for fn in cycle:
+        state, metrics = fn(state, batch)
+    float(metrics["loss"])
+
+    # Timing: median of 3 reps of `steps` steps walking the schedule.
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, metrics = cycle[i % len(cycle)](state, batch)
+        float(metrics["loss"])
+        rates.append(batch_size * steps / (time.perf_counter() - t0))
+    rates.sort()
+    median = rates[len(rates) // 2]
+    spread = (rates[-1] - rates[0]) / median
+
+    # Loss curve: fresh state, fixed data stream (new synthetic batch per
+    # step from a fixed seed so every variant sees identical data).
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import resnet as rn
+
+    state, _, stats_step, frozen_step = build(variant, batch_size,
+                                              image_size, tiny)
+    cycle = step_schedule(variant, stats_step, frozen_step)
+    losses = []
+    for i in range(loss_steps):
+        b = rn.synthetic_batch(jax.random.PRNGKey(1000 + i),
+                               batch_size=batch_size,
+                               image_size=image_size,
+                               num_classes=num_classes)
+        b["inputs"] = jnp.asarray(b["inputs"]).astype(jnp.bfloat16)
+        b["labels"] = jnp.asarray(b["labels"])
+        state, metrics = cycle[i % len(cycle)](state, b)
+        if (i + 1) % loss_every == 0 or i == 0:
+            losses.append((i + 1, round(float(metrics["loss"]), 4)))
+    return {
+        "images_per_sec": round(median, 2),
+        "spread_frac": round(spread, 4),
+        "loss_curve": losses,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--variants", default="bn,bn_bf16,group,bn_every_4,affine")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--loss-steps", type=int, default=120)
+    ap.add_argument("--loss-every", type=int, default=10)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes for CPU smoke")
+    args = ap.parse_args()
+    if args.smoke:
+        args.batch, args.image_size = 8, 32
+        args.steps, args.loss_steps, args.loss_every = 3, 8, 2
+
+    results = {}
+    for variant in args.variants.split(","):
+        variant = variant.strip()
+        t0 = time.perf_counter()
+        results[variant] = run_variant(variant, args.batch,
+                                       args.image_size, args.steps,
+                                       args.loss_steps, args.loss_every,
+                                       tiny=args.smoke)
+        results[variant]["wall_s"] = round(time.perf_counter() - t0, 1)
+        print(json.dumps({variant: results[variant]}), flush=True)
+
+    base = results.get("bn")
+    if base:
+        for variant, r in results.items():
+            r["speedup_vs_bn"] = round(
+                r["images_per_sec"] / base["images_per_sec"], 3)
+            # Accuracy proxy: max |Δloss| against the baseline curve at
+            # matching steps (identical data stream).
+            base_curve = dict(base["loss_curve"])
+            deltas = [abs(loss - base_curve[s])
+                      for s, loss in r["loss_curve"] if s in base_curve]
+            r["max_loss_delta_vs_bn"] = round(max(deltas), 4) if deltas else None
+    print(json.dumps(results, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -189,3 +189,93 @@ def test_mnist_cnn_learns():
         state, m = step(state, batch)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0] - 0.3  # memorizes the fixed batch
+
+
+@pytest.mark.parametrize("norm", ["bn_bf16", "group", "affine"])
+def test_resnet_norm_variants_train(norm):
+    """Every normalization scheme (docs/benchmarks.md experiment set)
+    builds, trains, and reduces loss on the tiny config."""
+    mesh = make_mesh(MeshConfig(dp=-1))
+    cfg = dataclasses.replace(rn.resnet_tiny(), norm=norm)
+    tr = Trainer(model=rn.ResNet(cfg), param_axes_fn=rn.param_logical_axes,
+                 rules=CNN_RULES, mesh=mesh, optimizer=optax.adam(1e-3),
+                 loss_fn=classification_loss)
+    rng = jax.random.PRNGKey(0)
+    batch = rn.synthetic_batch(rng, batch_size=16, image_size=32,
+                               num_classes=10)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()}
+    state, shardings = tr.init(rng, batch)
+    step = tr.make_train_step(shardings, batch)
+    losses = []
+    for _ in range(5):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    if norm == "group":
+        # GroupNorm keeps no running statistics.
+        assert not state.extra_vars
+
+
+def test_resnet_frozen_stats_step():
+    """Interval statistics: the frozen step (update_stats=False) trains
+    params, leaves batch_stats untouched, and normalizes with running
+    stats (differs from the stats step's batch-stat normalization)."""
+    from tf_operator_tpu.train.trainer import classification_loss_frozen_stats
+
+    mesh = make_mesh(MeshConfig(dp=-1))
+    cfg = rn.resnet_tiny()
+
+    def trainer(loss_fn):
+        return Trainer(model=rn.ResNet(cfg),
+                       param_axes_fn=rn.param_logical_axes,
+                       rules=CNN_RULES, mesh=mesh,
+                       optimizer=optax.adam(1e-3), loss_fn=loss_fn)
+
+    rng = jax.random.PRNGKey(0)
+    batch = rn.synthetic_batch(rng, batch_size=16, image_size=32,
+                               num_classes=10)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()}
+    tr_stats = trainer(classification_loss)
+    state, shardings = tr_stats.init(rng, batch)
+    stats_step = tr_stats.make_train_step(shardings, batch)
+    frozen_step = trainer(classification_loss_frozen_stats) \
+        .make_train_step(shardings, batch)
+
+    # One stats step to warm running stats, then a frozen step.
+    state, m1 = stats_step(state, batch)
+    stats_after = jax.tree.map(lambda x: np.asarray(x).copy(),
+                               state.extra_vars)
+    params_before = jax.tree.leaves(state.params)[0].copy()
+    state, m2 = frozen_step(state, batch)
+    # params moved, stats did not
+    assert not np.allclose(params_before, jax.tree.leaves(state.params)[0])
+    for a, b in zip(jax.tree.leaves(stats_after),
+                    jax.tree.leaves(state.extra_vars)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.isfinite(float(m2["loss"]))
+
+
+def test_tpu_batch_norm_matches_flax():
+    """The TPU-formulated BN must be numerically equivalent to
+    flax.linen.BatchNorm (values and updated statistics)."""
+    import flax.linen as nn
+
+    from tf_operator_tpu.ops.layers import tpu_batch_norm
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 14, 14, 32),
+                          jnp.float32) * 2 + 1
+    m = tpu_batch_norm()
+    v = m.init(jax.random.PRNGKey(1), x)
+    y, upd = m.apply(v, x, mutable=["batch_stats"])
+    ref = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                       epsilon=1e-5)
+    vr = ref.init(jax.random.PRNGKey(1), x)
+    yr, updr = ref.apply(vr, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(upd["batch_stats"]["mean"]),
+        np.asarray(updr["batch_stats"]["mean"]), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(upd["batch_stats"]["var"]),
+        np.asarray(updr["batch_stats"]["var"]), atol=1e-4, rtol=1e-3)

@@ -26,6 +26,16 @@ class ResNetConfig:
     num_classes: int = 1000
     width: int = 64
     dtype: Any = jnp.bfloat16
+    # Normalization scheme (measured trade-offs in docs/benchmarks.md):
+    #   "bn"        batch norm, f32 statistics (flax-equivalent default)
+    #   "bn_bf16"   batch norm with bf16 statistics accumulation
+    #   "group"     GroupNorm(32) — no batch statistics, no running state
+    #   "affine"    per-channel scale/bias only (frozen unit stats):
+    #               throughput ceiling probe for norm-free schemes
+    # "bn"/"bn_bf16" also support interval statistics: call the model
+    # with update_stats=False to normalize with running stats (pure
+    # affine, no reduces) — see Trainer stats_every_n.
+    norm: str = "bn"
 
 
 def resnet50(num_classes: int = 1000) -> ResNetConfig:
@@ -36,19 +46,60 @@ def resnet_tiny(num_classes: int = 10) -> ResNetConfig:
     return ResNetConfig(stage_sizes=(1, 1), width=8, num_classes=num_classes)
 
 
+def _norm_factory(cfg: ResNetConfig, train: bool, update_stats: bool):
+    """Normalization layer constructor for the configured scheme.
+
+    ``update_stats=False`` under "bn"/"bn_bf16" normalizes with running
+    statistics (pure per-channel affine, zero reduces) — the interval-
+    statistics building block.
+    """
+    from tf_operator_tpu.ops.layers import tpu_batch_norm
+
+    common = dict(dtype=cfg.dtype, param_dtype=jnp.float32)
+    if cfg.norm in ("bn", "bn_bf16"):
+        stats = jnp.float32 if cfg.norm == "bn" else jnp.bfloat16
+        return partial(tpu_batch_norm,
+                       use_running_average=not (train and update_stats),
+                       momentum=0.9, epsilon=1e-5, stats_dtype=stats,
+                       **common)
+    if cfg.norm == "group":
+        return partial(_GroupNormAuto, dtype=cfg.dtype)
+    if cfg.norm == "affine":
+        return partial(tpu_batch_norm, use_running_average=True,
+                       track_stats=False, epsilon=1e-5, **common)
+    raise ValueError(f"unknown norm scheme {cfg.norm!r}")
+
+
+class _GroupNormAuto(nn.Module):
+    """GroupNorm with 32 groups, degrading gracefully on narrow layers
+    (gcd with the channel count) so tiny test configs still build."""
+
+    dtype: Any = jnp.bfloat16
+    scale_init: Any = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        import math
+
+        feat = x.shape[-1]
+        groups = 32 if feat % 32 == 0 else math.gcd(32, feat)
+        return nn.GroupNorm(num_groups=groups, epsilon=1e-5,
+                            dtype=self.dtype, param_dtype=jnp.float32,
+                            scale_init=self.scale_init)(x)
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: int
     config: ResNetConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+    def __call__(self, x: jax.Array, train: bool = True,
+                 update_stats: bool = True) -> jax.Array:
         cfg = self.config
         conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype,
                        param_dtype=jnp.float32)
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=cfg.dtype,
-                       param_dtype=jnp.float32)
+        norm = _norm_factory(cfg, train, update_stats)
         residual = x
         y = conv(self.filters, (1, 1), name="conv1")(x)
         y = nn.relu(norm(name="bn1")(y))
@@ -69,22 +120,22 @@ class ResNet(nn.Module):
     config: ResNetConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+    def __call__(self, x: jax.Array, train: bool = True,
+                 update_stats: bool = True) -> jax.Array:
         cfg = self.config
         x = x.astype(cfg.dtype)
         x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
                     use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
                     name="stem_conv")(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=cfg.dtype,
-                         param_dtype=jnp.float32, name="stem_bn")(x)
+        x = _norm_factory(cfg, train, update_stats)(name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, n_blocks in enumerate(cfg.stage_sizes):
             for block in range(n_blocks):
                 strides = 2 if stage > 0 and block == 0 else 1
                 x = BottleneckBlock(cfg.width * (2 ** stage), strides, cfg,
-                                    name=f"stage{stage}_block{block}")(x, train)
+                                    name=f"stage{stage}_block{block}")(
+                                        x, train, update_stats)
         x = jnp.mean(x, axis=(1, 2))
         x = x.astype(jnp.float32)
         return nn.Dense(cfg.num_classes, name="classifier",

@@ -82,3 +82,97 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
         logits = jnp.where(mask, logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("...hst,...thd->...shd", weights, v)
+
+
+def make_tpu_batch_norm():
+    """Define the flax TPUBatchNorm module (deferred so this module keeps
+    its jax-only import surface; models import flax anyway)."""
+    import flax.linen as nn
+
+    class _TPUBatchNorm(nn.Module):
+        """BatchNorm formulated for the TPU cost structure.
+
+        Differences from ``flax.linen.BatchNorm`` that matter on parts
+        where the VPU/reduce rate — not the MXU — bounds ResNet steps
+        (BASELINE.md platform characterization):
+
+        - ``stats_dtype`` controls the statistics accumulation dtype.
+          f32 (default) matches flax; bf16 skips the convert half of the
+          convert+reduce fusions that dominate the profiled step.
+        - normalization folds to one per-channel affine ``y = x*a + b``
+          with ``a = scale/sqrt(var+eps)``, ``b = bias - mean*a``
+          computed in f32 on the tiny [C] vectors, so the big-tensor op
+          is a single fused multiply-add in the activation dtype (XLA
+          fuses it into the producing conv's epilogue).
+        - ``use_running_average=True`` makes the layer a pure affine
+          read of stored statistics — the building block for interval /
+          frozen statistics schemes (stats every N steps).
+
+        Variance uses E[x²]−E[x]² (one fused pass instead of a second
+        centered pass — flax ``use_fast_variance`` semantics).
+        """
+
+        use_running_average: bool = False
+        momentum: float = 0.9
+        epsilon: float = 1e-5
+        dtype: object = None
+        param_dtype: object = jnp.float32
+        stats_dtype: object = jnp.float32
+        scale_init: object = nn.initializers.ones
+        track_stats: bool = True
+
+        @nn.compact
+        def __call__(self, x: jax.Array) -> jax.Array:
+            feat = x.shape[-1]
+            scale = self.param("scale", self.scale_init, (feat,),
+                               self.param_dtype)
+            bias = self.param("bias", nn.initializers.zeros, (feat,),
+                              self.param_dtype)
+            if self.track_stats:
+                ra_mean = self.variable(
+                    "batch_stats", "mean",
+                    lambda: jnp.zeros((feat,), jnp.float32))
+                ra_var = self.variable(
+                    "batch_stats", "var",
+                    lambda: jnp.ones((feat,), jnp.float32))
+            if self.use_running_average or self.is_initializing():
+                if self.track_stats:
+                    mean, var = ra_mean.value, ra_var.value
+                else:
+                    # Frozen unit statistics: a pure per-channel affine
+                    # (the norm-free ceiling probe) — zero reduces.
+                    mean = jnp.zeros((feat,), jnp.float32)
+                    var = jnp.ones((feat,), jnp.float32)
+            else:
+                axes = tuple(range(x.ndim - 1))
+                xs = x.astype(self.stats_dtype)
+                mean = jnp.mean(xs, axis=axes)
+                var = jnp.mean(jnp.square(xs), axis=axes) \
+                    - jnp.square(mean)
+                mean = mean.astype(jnp.float32)
+                var = jnp.maximum(var.astype(jnp.float32), 0.0)
+                if self.track_stats:
+                    ra_mean.value = (self.momentum * ra_mean.value
+                                     + (1.0 - self.momentum) * mean)
+                    ra_var.value = (self.momentum * ra_var.value
+                                    + (1.0 - self.momentum) * var)
+            a = scale.astype(jnp.float32) * jax.lax.rsqrt(
+                var + self.epsilon)
+            b = bias.astype(jnp.float32) - mean * a
+            out_dtype = self.dtype or x.dtype
+            if out_dtype == jnp.float32:
+                return x.astype(jnp.float32) * a + b
+            return x * a.astype(out_dtype) + b.astype(out_dtype)
+
+    return _TPUBatchNorm
+
+
+_tpu_bn_cls = None
+
+
+def tpu_batch_norm(**kwargs):
+    """TPUBatchNorm module instance (see make_tpu_batch_norm)."""
+    global _tpu_bn_cls
+    if _tpu_bn_cls is None:
+        _tpu_bn_cls = make_tpu_batch_norm()
+    return _tpu_bn_cls(**kwargs)

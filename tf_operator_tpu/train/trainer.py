@@ -228,6 +228,21 @@ def classification_loss(params, extra_vars, batch, model_apply):
 classification_loss.model_inputs_fn = lambda b: (b["inputs"],)
 
 
+def classification_loss_frozen_stats(params, extra_vars, batch, model_apply):
+    """Classification step normalizing with *running* statistics (the
+    model's ``update_stats=False`` path — zero batch-stats reduces).
+    Building block for interval statistics: run 1 statistics step
+    (``classification_loss``) every N, frozen steps in between; measured
+    trade-offs in docs/benchmarks.md. Requires a model whose __call__
+    accepts ``update_stats`` (models/resnet.py)."""
+    logits = model_apply({"params": params, **(extra_vars or {})},
+                         batch["inputs"], update_stats=False)
+    return cross_entropy_loss(logits, batch["labels"]), extra_vars
+
+
+classification_loss_frozen_stats.model_inputs_fn = lambda b: (b["inputs"],)
+
+
 def default_optimizer(learning_rate: float = 3e-4,
                       weight_decay: float = 0.1,
                       warmup_steps: int = 100,
