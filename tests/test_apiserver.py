@@ -15,8 +15,6 @@ import pytest
 from tf_operator_tpu import testutil
 from tf_operator_tpu.api.types import (
     Container,
-    Node,
-    NodeSpec,
     Pod,
     PodPhase,
     PodSpec,

@@ -76,3 +76,58 @@ def test_example_spec_valid(path):
     validate_job(job)
     # wire round-trip is lossless
     assert TPUJob.from_dict(job.to_dict()).to_dict() == job.to_dict()
+
+
+def test_checked_in_crd_matches_generated():
+    sys.path.insert(0, os.path.join(REPO, "manifests"))
+    import gen as manifests_gen
+
+    with open(os.path.join(REPO, "manifests", "base", "crd.yaml")) as f:
+        assert f.read() == manifests_gen.render_crd(), (
+            "manifests/base/crd.yaml is stale; run python manifests/gen.py")
+
+
+def test_crd_schema_is_structural():
+    """Kubernetes structural-schema rules: no $ref, every node typed,
+    no additionalProperties alongside properties."""
+    from tf_operator_tpu.api.schema import generate_crd_schema
+
+    def walk(node, path="root"):
+        assert "$ref" not in node, f"$ref at {path}"
+        assert node.get("type") or "x-kubernetes-preserve-unknown-fields" \
+            in node, f"untyped node at {path}"
+        assert not ("properties" in node and "additionalProperties" in node), \
+            f"properties+additionalProperties at {path}"
+        for key, child in (node.get("properties") or {}).items():
+            walk(child, f"{path}.{key}")
+        if isinstance(node.get("additionalProperties"), dict):
+            walk(node["additionalProperties"], f"{path}[*]")
+        if isinstance(node.get("items"), dict):
+            walk(node["items"], f"{path}[]")
+
+    schema = generate_crd_schema()
+    walk(schema)
+    # spec must cover the job surface a user writes.
+    spec_props = schema["properties"]["spec"]["properties"]
+    for key in ("replicaSpecs", "runPolicy", "successPolicy", "slice"):
+        assert key in spec_props
+
+
+def test_rbac_manifest_parses_and_covers_runtime_verbs():
+    with open(os.path.join(REPO, "manifests", "base", "rbac.yaml")) as f:
+        docs = list(yaml.safe_load_all(f))
+    kinds = {d["kind"] for d in docs}
+    assert kinds == {"ServiceAccount", "ClusterRole", "ClusterRoleBinding"}
+    role = next(d for d in docs if d["kind"] == "ClusterRole")
+    rules = {(g, r): set(rule["verbs"])
+             for rule in role["rules"]
+             for g in rule["apiGroups"] for r in rule["resources"]}
+    # The verbs runtime/kube.py actually issues.
+    assert {"create", "delete", "patch", "list",
+            "watch"} <= rules[("", "pods")]
+    assert {"get", "list", "watch",
+            "patch"} <= rules[("tpu-operator.dev", "tpujobs")]
+    assert "patch" in rules[("tpu-operator.dev", "tpujobs/status")]
+    assert {"get", "create", "update"} <= rules[
+        ("coordination.k8s.io", "leases")]
+    assert "create" in rules[("", "events")]

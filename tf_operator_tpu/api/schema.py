@@ -84,3 +84,58 @@ def generate_schema(cls=None) -> dict:
     if defs:
         schema["$defs"] = dict(sorted(defs.items()))
     return schema
+
+
+# ---------------------------------------------------------------------------
+# CRD structural schema (reference: manifests/base/crd.yaml openAPIV3Schema,
+# backed by openapi_generated.go). Kubernetes structural schemas forbid
+# $ref and sibling additionalProperties/properties, so this variant
+# inlines definitions and keeps every node typed.
+# ---------------------------------------------------------------------------
+
+def _structural(tp: Any, depth: int = 0) -> dict:
+    if depth > 16:  # cycle guard: no API type recurses, this is a backstop
+        return {"type": "object",
+                "x-kubernetes-preserve-unknown-fields": True}
+    tp = _unwrap_optional(tp)
+    if tp in _PRIMITIVES:
+        return dict(_PRIMITIVES[tp])
+    if tp is _dt.datetime:
+        return {"type": "string", "format": "date-time"}
+    if tp is Any or tp is object:
+        return {"type": "object",
+                "x-kubernetes-preserve-unknown-fields": True}
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        args = get_args(tp)
+        item = (_structural(args[0], depth + 1) if args
+                else {"type": "object",
+                      "x-kubernetes-preserve-unknown-fields": True})
+        return {"type": "array", "items": item}
+    if origin is dict:
+        args = get_args(tp)
+        val = (_structural(args[1], depth + 1) if len(args) == 2
+               else {"type": "string"})
+        return {"type": "object", "additionalProperties": val}
+    if isinstance(tp, type) and issubclass(tp, ApiObject):
+        props = {}
+        for f in dataclasses.fields(tp):
+            hint = _hints_for(tp).get(f.name, Any)
+            props[snake_to_camel(f.name)] = _structural(hint, depth + 1)
+        return {"type": "object", "properties": props}
+    return {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+
+
+def generate_crd_schema() -> dict:
+    """openAPIV3Schema for the TPUJob CRD: spec + status only (metadata
+    belongs to the API machinery; reference crd.yaml:22-47 likewise
+    validates only replica bounds under spec)."""
+    from tf_operator_tpu.api.types import JobStatus, TPUJobSpec
+
+    return {
+        "type": "object",
+        "properties": {
+            "spec": _structural(TPUJobSpec),
+            "status": _structural(JobStatus),
+        },
+    }

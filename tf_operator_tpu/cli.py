@@ -71,10 +71,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "clients and node agents connect here")
     p.add_argument("--api-host", default="127.0.0.1",
                    help="bind address for the control-plane API")
-    p.add_argument("--backend", choices=("local", "none"), default="local",
+    p.add_argument("--backend", choices=("local", "none", "kube"),
+                   default="local",
                    help="data plane: 'local' runs pods as subprocesses "
                         "in this process; 'none' leaves pods to external "
-                        "node agents (requires --api-port)")
+                        "node agents (requires --api-port); 'kube' "
+                        "reconciles TPUJob CRs / pods / services against "
+                        "a Kubernetes API server (CRD from "
+                        "manifests/base/crd.yaml must be installed)")
+    p.add_argument("--kubeconfig", default=None,
+                   help="kubeconfig path for --backend kube (default: "
+                        "in-cluster config when available, else "
+                        "$KUBECONFIG or ~/.kube/config)")
     p.add_argument("--resync-period", type=float, default=30.0,
                    help="idle full re-enqueue period in seconds (0 = off)")
     p.add_argument("--leader-elect", default=True,
@@ -96,16 +104,43 @@ class Server:
         # wires this to its stop event so shutdown runs on the main
         # thread, never on the elector's own thread.
         self.on_fatal = on_fatal
-        self.store = store or store_mod.Store()
-        op_kwargs = {}
-        if getattr(args, "backend", "local") == "none":
-            op_kwargs["backend"] = None
-        self.operator = Operator(
-            store=self.store,
-            namespace=args.namespace or None,
-            enable_gang_scheduling=args.enable_gang_scheduling,
-            total_chips=args.total_chips,
-            **op_kwargs)
+        self._lease_store = None
+        if getattr(args, "backend", "local") == "kube":
+            # Cluster mode: the Store is the informer cache inside
+            # KubeOperator; reads/writes/leases go to the K8s API.
+            from tf_operator_tpu.runtime.kube import (
+                KubeClient,
+                KubeConfig,
+                KubeLeaseStore,
+                KubeOperator,
+                check_crd_exists,
+            )
+
+            client = KubeClient(
+                KubeConfig.resolve(getattr(args, "kubeconfig", None)))
+            if not check_crd_exists(client):
+                # Fail fast like the reference (server.go:124, 232-251).
+                raise RuntimeError(
+                    f"CRD not installed on {client.config.server}; apply "
+                    "manifests/base/crd.yaml first")
+            self.operator = KubeOperator(
+                client,
+                namespace=args.namespace or None,
+                enable_gang_scheduling=args.enable_gang_scheduling,
+                total_chips=args.total_chips)
+            self.store = self.operator.store
+            self._lease_store = KubeLeaseStore(client)
+        else:
+            self.store = store or store_mod.Store()
+            op_kwargs = {}
+            if getattr(args, "backend", "local") == "none":
+                op_kwargs["backend"] = None
+            self.operator = Operator(
+                store=self.store,
+                namespace=args.namespace or None,
+                enable_gang_scheduling=args.enable_gang_scheduling,
+                total_chips=args.total_chips,
+                **op_kwargs)
         self.api_server = None
         if getattr(args, "api_port", 0) != 0:
             from tf_operator_tpu.runtime.apiserver import APIServer
@@ -121,7 +156,7 @@ class Server:
         self.elector: Optional[LeaderElector] = None
         if args.leader_elect:
             self.elector = LeaderElector(
-                self.store,
+                self._lease_store or self.store,
                 identity=args.leader_elect_identity,
                 namespace=args.namespace or "default",
                 lease_duration=LEASE_DURATION,
@@ -133,7 +168,20 @@ class Server:
         self._resync_thread: Optional[threading.Thread] = None
 
     def _start_reconciling(self) -> None:
-        self.operator.start(threadiness=self.args.threadiness)
+        try:
+            self.operator.start(threadiness=self.args.threadiness)
+        except Exception:
+            # Runs on the elector's daemon thread: swallowing the failure
+            # would leave a zombie leader renewing the lease while never
+            # reconciling, blocking standby failover. Fatal instead.
+            log.exception("operator failed to start; shutting down")
+            self._stop.set()
+            if self.on_fatal is not None:
+                self.on_fatal()
+            else:
+                threading.Thread(target=self.shutdown, name="shutdown",
+                                 daemon=True).start()
+            return
         if self.args.resync_period > 0:
             self._resync_thread = threading.Thread(
                 target=self._resync_loop, name="resync", daemon=True)
@@ -195,6 +243,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.error("--backend none needs --api-port: without a served "
                      "API no node agent can reach the control plane, so "
                      "pods would sit Pending forever")
+    if args.backend == "kube" and args.api_port != 0:
+        parser.error("--backend kube cannot serve --api-port: the Store "
+                     "is a read cache of the cluster there, so jobs "
+                     "submitted through the served API would be dropped "
+                     "on the next relist; submit TPUJob CRs to the "
+                     "Kubernetes API server instead")
     if args.version:
         print(version_string())
         return 0

@@ -191,9 +191,28 @@ class TPUJobController(JobPlugin):
             return None
         return job.key()
 
+    def _orphan_job_key(self, obj) -> Optional[str]:
+        """For an ownerless object, resolve the job its labels select so
+        that job can adopt it on the next sync (reference AddPod's
+        getPodJobs label-resolution path, common/pod.go:85-105)."""
+        if obj.metadata.controller_ref() is not None:
+            return None
+        labels = obj.metadata.labels
+        if labels.get(constants.LABEL_GROUP_NAME) != constants.GROUP:
+            return None
+        name = labels.get(constants.LABEL_JOB_NAME)
+        if not name:
+            return None
+        job = self.store.try_get(store_mod.TPUJOBS, obj.metadata.namespace,
+                                 name)
+        return None if job is None else job.key()
+
     def _on_pod_event(self, event_type: str, pod: Pod) -> None:
         job_key = self._resolve_job_key(pod)
         if job_key is None:
+            orphan_key = self._orphan_job_key(pod)
+            if orphan_key is not None:
+                self.enqueue(orphan_key)
             return
         rtype = pod.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "")
         key = expectation_key(job_key, "pods", rtype)
@@ -206,6 +225,9 @@ class TPUJobController(JobPlugin):
     def _on_endpoint_event(self, event_type: str, ep: Endpoint) -> None:
         job_key = self._resolve_job_key(ep)
         if job_key is None:
+            orphan_key = self._orphan_job_key(ep)
+            if orphan_key is not None:
+                self.enqueue(orphan_key)
             return
         rtype = ep.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "")
         key = expectation_key(job_key, "endpoints", rtype)
@@ -343,15 +365,23 @@ class TPUJobController(JobPlugin):
                 if job.metadata.deletion_timestamp is not None:
                     continue
                 obj.metadata.owner_references.append(controller_owner_ref(job))
-                try:
-                    obj = self.store.update(kind, obj)
-                except (store_mod.ConflictError, store_mod.NotFoundError):
-                    continue
-                claimed.append(obj)
+                obj = self._persist_adoption(kind, obj)
+                if obj is not None:
+                    claimed.append(obj)
             elif ref.uid == job.metadata.uid:
                 claimed.append(obj)
             # else: owned by another controller -> leave it alone
         return claimed
+
+    def _persist_adoption(self, kind: str, obj):
+        """Persist a newly-stamped controller ownerReference (reference
+        AdoptPod's ownership patch, controller_ref_manager.go:208-221).
+        Returns the updated object, or None when the object changed or
+        vanished underneath us (retry next sync)."""
+        try:
+            return self.store.update(kind, obj)
+        except (store_mod.ConflictError, store_mod.NotFoundError):
+            return None
 
     def delete_job(self, job: TPUJob) -> None:
         """Reference DeleteJob (tensorflow/job.go:39-55)."""

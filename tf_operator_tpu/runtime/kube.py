@@ -1,0 +1,929 @@
+"""Kubernetes cluster backend: the operator against a real API server.
+
+Reference parity:
+
+- config resolution + clientsets: cmd/tf-operator.v1/app/server.go:72-229
+  (kubeconfig / in-cluster, five clientsets) — here one stdlib REST client.
+- RealPodControl / RealServiceControl: vendor/.../control/pod_control.go:66+,
+  service_control.go (create/delete with controller ownerRefs + events).
+- Informer list+watch feeding the controller's cache: the generated
+  informer factory (pkg/client/informers/) + unstructured TFJob informer
+  (pkg/controller.v1/tensorflow/informer.go:33-53).
+- Adoption ownership patch: controller_ref_manager.go:208-221.
+- Status writes via the CRD status subresource: tensorflow/status.go:222-240.
+
+Design: the reconcile engine is unchanged. The in-process ``Store`` plays
+the informer-cache role: ``KubeInformer`` threads list+watch TPUJob CRs,
+Pods, and Services from the cluster and mirror them into the Store (which
+fires the controller's existing watch handlers, driving expectations and
+the workqueue exactly as in the local runtime). The write path —
+``KubePodControl``/``KubeEndpointControl``, status patches, adoption
+patches — goes to the API server, and the resulting watch events close
+the loop: API write -> watch -> cache -> expectation observed.
+
+Everything here is stdlib (urllib + ssl + json; yaml only to parse
+kubeconfig): the runtime image carries no kubernetes client package, and
+the API subset the engine needs is small and stable.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import logging
+import os
+import ssl
+import tempfile
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, Dict, Optional, Tuple
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.serde import parse_time
+from tf_operator_tpu.api.types import (
+    Container,
+    ContainerStatus,
+    Endpoint,
+    EndpointSpec,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodSpec,
+    PodStatus,
+    TPUJob,
+)
+from tf_operator_tpu.runtime import metrics
+from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.events import (
+    EVENT_TYPE_NORMAL,
+    EVENT_TYPE_WARNING,
+    Recorder,
+)
+from tf_operator_tpu.runtime.store import Store
+
+log = logging.getLogger("tpu_operator.kube")
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# Restart policies core/v1 Pods accept; the engine maps ExitCode -> Never
+# before the control sees the pod (reference setRestartPolicy,
+# tensorflow/pod.go:319-326), this is the defensive backstop.
+_K8S_RESTART_POLICIES = ("Always", "OnFailure", "Never")
+
+
+class KubeApiError(Exception):
+    def __init__(self, code: int, reason: str, message: str):
+        super().__init__(f"{reason} ({code}): {message}")
+        self.code = code
+        self.reason = reason
+        self.message = message
+
+
+# ---------------------------------------------------------------------------
+# Config resolution (reference app/server.go:96-111 BuildConfigFromFlags)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class KubeConfig:
+    server: str = ""
+    token: str = ""
+    ca_file: str = ""
+    client_cert_file: str = ""
+    client_key_file: str = ""
+    verify: bool = True
+    namespace: str = "default"
+
+    @classmethod
+    def in_cluster(cls) -> "KubeConfig":
+        """Service-account config inside a pod (reference rest.InClusterConfig
+        via BuildConfigFromFlags with empty kubeconfig)."""
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise KubeApiError(0, "NoCluster",
+                               "KUBERNETES_SERVICE_HOST not set")
+        with open(os.path.join(SERVICE_ACCOUNT_DIR, "token")) as f:
+            token = f.read().strip()
+        ns_path = os.path.join(SERVICE_ACCOUNT_DIR, "namespace")
+        namespace = "default"
+        if os.path.exists(ns_path):
+            with open(ns_path) as f:
+                namespace = f.read().strip() or "default"
+        return cls(server=f"https://{host}:{port}", token=token,
+                   ca_file=os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt"),
+                   namespace=namespace)
+
+    @classmethod
+    def from_kubeconfig(cls, path: Optional[str] = None,
+                        context: Optional[str] = None) -> "KubeConfig":
+        """Parse a kubeconfig file (reference clientcmd loading rules)."""
+        import yaml
+
+        path = path or os.environ.get("KUBECONFIG") or os.path.expanduser(
+            "~/.kube/config")
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+
+        def _by_name(section: str, name: str) -> dict:
+            for entry in doc.get(section, []) or []:
+                if entry.get("name") == name:
+                    return entry
+            raise KubeApiError(0, "BadKubeconfig",
+                               f"{section} entry {name!r} not found in {path}")
+
+        ctx_name = context or doc.get("current-context", "")
+        if not ctx_name:
+            raise KubeApiError(0, "BadKubeconfig",
+                               f"no current-context in {path}")
+        ctx = _by_name("contexts", ctx_name).get("context", {})
+        cluster = _by_name("clusters", ctx.get("cluster", "")).get("cluster", {})
+        user = _by_name("users", ctx.get("user", "")).get("user", {})
+
+        def _materialize(data_key: str, file_key: str, src: dict) -> str:
+            """Inline base64 *-data fields become temp files for ssl."""
+            if src.get(file_key):
+                return src[file_key]
+            data = src.get(data_key)
+            if not data:
+                return ""
+            fd, tmp = tempfile.mkstemp(prefix="kubecfg-", suffix=".pem")
+            with os.fdopen(fd, "wb") as f:
+                f.write(base64.b64decode(data))
+            return tmp
+
+        return cls(
+            server=cluster.get("server", ""),
+            token=user.get("token", ""),
+            ca_file=_materialize("certificate-authority-data",
+                                 "certificate-authority", cluster),
+            client_cert_file=_materialize("client-certificate-data",
+                                          "client-certificate", user),
+            client_key_file=_materialize("client-key-data", "client-key",
+                                         user),
+            verify=not cluster.get("insecure-skip-tls-verify", False),
+            namespace=ctx.get("namespace", "default"),
+        )
+
+    @classmethod
+    def resolve(cls, kubeconfig: Optional[str] = None) -> "KubeConfig":
+        """In-cluster when running inside a pod, else kubeconfig —
+        the reference's loading order (server.go:96-103)."""
+        if not kubeconfig and os.environ.get("KUBERNETES_SERVICE_HOST"):
+            return cls.in_cluster()
+        return cls.from_kubeconfig(kubeconfig)
+
+
+# ---------------------------------------------------------------------------
+# REST client
+# ---------------------------------------------------------------------------
+
+def _selector_str(selector: Optional[Dict[str, str]]) -> str:
+    if not selector:
+        return ""
+    return ",".join(f"{k}={v}" for k, v in sorted(selector.items()))
+
+
+class KubeClient:
+    """Minimal typed REST client over the K8s API (stdlib only)."""
+
+    def __init__(self, config: KubeConfig, timeout: float = 30.0):
+        self.config = config
+        self.timeout = timeout
+        self._ssl: Optional[ssl.SSLContext] = None
+        if config.server.startswith("https"):
+            ctx = ssl.create_default_context(
+                cafile=config.ca_file or None)
+            if config.client_cert_file:
+                ctx.load_cert_chain(config.client_cert_file,
+                                    config.client_key_file or None)
+            if not config.verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            self._ssl = ctx
+
+    # -- plumbing ----------------------------------------------------------
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None,
+                params: Optional[Dict[str, str]] = None,
+                content_type: str = "application/json",
+                timeout: Optional[float] = None,
+                stream: bool = False):
+        url = self.config.server.rstrip("/") + path
+        if params:
+            url += "?" + urllib.parse.urlencode(
+                {k: v for k, v in params.items() if v not in ("", None)})
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self.config.token:
+            req.add_header("Authorization", f"Bearer {self.config.token}")
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=self.timeout if timeout is None else timeout,
+                context=self._ssl)
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            try:
+                status = json.loads(raw or b"{}")
+            except json.JSONDecodeError:
+                status = {}
+            reason = status.get("reason", "") or e.reason
+            message = status.get("message", "") or raw.decode(
+                "utf-8", "replace")
+            if e.code == 404:
+                raise store_mod.NotFoundError(message)
+            if e.code == 409 and reason == "AlreadyExists":
+                raise store_mod.AlreadyExistsError(message)
+            if e.code == 409:
+                raise store_mod.ConflictError(message)
+            raise KubeApiError(e.code, reason, message)
+        if stream:
+            return resp
+        with resp:
+            raw = resp.read()
+        return json.loads(raw) if raw else {}
+
+    # -- path builders -----------------------------------------------------
+
+    @staticmethod
+    def _core(resource: str, ns: Optional[str], name: str = "") -> str:
+        base = (f"/api/v1/namespaces/{ns}/{resource}" if ns
+                else f"/api/v1/{resource}")
+        return f"{base}/{name}" if name else base
+
+    @staticmethod
+    def _crd(ns: Optional[str], name: str = "") -> str:
+        group = f"/apis/{constants.GROUP}/{constants.VERSION}"
+        base = (f"{group}/namespaces/{ns}/{constants.PLURAL}" if ns
+                else f"{group}/{constants.PLURAL}")
+        return f"{base}/{name}" if name else base
+
+    def _path(self, kind: str, ns: Optional[str], name: str = "") -> str:
+        if kind == store_mod.TPUJOBS:
+            return self._crd(ns, name)
+        resource = "services" if kind == store_mod.ENDPOINTS else "pods"
+        return self._core(resource, ns, name)
+
+    # -- typed verbs -------------------------------------------------------
+
+    def create(self, kind: str, ns: str, body: dict) -> dict:
+        return self.request("POST", self._path(kind, ns), body=body)
+
+    def get(self, kind: str, ns: str, name: str) -> dict:
+        return self.request("GET", self._path(kind, ns, name))
+
+    def delete(self, kind: str, ns: str, name: str) -> dict:
+        return self.request("DELETE", self._path(kind, ns, name))
+
+    def list(self, kind: str, ns: Optional[str] = None,
+             selector: Optional[Dict[str, str]] = None) -> dict:
+        return self.request("GET", self._path(kind, ns),
+                            params={"labelSelector": _selector_str(selector)})
+
+    def patch(self, kind: str, ns: str, name: str, patch: dict,
+              subresource: str = "") -> dict:
+        path = self._path(kind, ns, name)
+        if subresource:
+            path += f"/{subresource}"
+        return self.request("PATCH", path, body=patch,
+                            content_type="application/merge-patch+json")
+
+    def create_event(self, ns: str, body: dict) -> dict:
+        return self.request("POST", self._core("events", ns), body=body)
+
+    def watch(self, kind: str, ns: Optional[str],
+              selector: Optional[Dict[str, str]],
+              resource_version: str,
+              resp_box: Optional[list] = None):
+        """Open a watch stream; yields (type, raw_object) tuples until the
+        server closes the connection (callers reconnect; reference
+        ListWatch + reflector relist semantics). ``resp_box`` receives the
+        live response object so the caller can close it to abort a
+        blocking read (informer shutdown)."""
+        params = {"watch": "1",
+                  "labelSelector": _selector_str(selector),
+                  "allowWatchBookmarks": "true",
+                  "timeoutSeconds": "300",
+                  "resourceVersion": resource_version}
+        resp = self.request("GET", self._path(kind, ns), params=params,
+                            timeout=330.0, stream=True)
+        if resp_box is not None:
+            resp_box.clear()
+            resp_box.append(resp)
+        try:
+            for line in resp:
+                line = line.strip()
+                if not line:
+                    continue  # keepalive (fake apiserver liveness blanks)
+                event = json.loads(line)
+                yield event.get("type", ""), event.get("object", {})
+        finally:
+            resp.close()
+
+
+# ---------------------------------------------------------------------------
+# Wire translation: framework dataclasses <-> core/v1 + CRD objects
+# ---------------------------------------------------------------------------
+
+def _meta_to_k8s(meta: ObjectMeta) -> dict:
+    out: dict = {"name": meta.name, "namespace": meta.namespace}
+    if meta.labels:
+        out["labels"] = dict(meta.labels)
+    if meta.annotations:
+        out["annotations"] = dict(meta.annotations)
+    if meta.owner_references:
+        out["ownerReferences"] = [r.to_dict() for r in meta.owner_references]
+    return out
+
+
+def _meta_from_k8s(d: dict) -> ObjectMeta:
+    rv_raw = d.get("resourceVersion", 0)
+    try:
+        rv = int(rv_raw)
+    except (TypeError, ValueError):
+        rv = 0
+    return ObjectMeta(
+        name=d.get("name", ""),
+        namespace=d.get("namespace", "default"),
+        uid=d.get("uid", ""),
+        labels=dict(d.get("labels") or {}),
+        annotations=dict(d.get("annotations") or {}),
+        creation_timestamp=parse_time(d.get("creationTimestamp")),
+        deletion_timestamp=parse_time(d.get("deletionTimestamp")),
+        resource_version=rv,
+        owner_references=[OwnerReference.from_dict(r)
+                          for r in d.get("ownerReferences") or []],
+    )
+
+
+def k8s_resource_version(d: dict) -> str:
+    return str((d.get("metadata") or {}).get("resourceVersion", "") or "")
+
+
+def pod_to_k8s(pod: Pod) -> dict:
+    containers = []
+    for c in pod.spec.containers:
+        kc: dict = {"name": c.name}
+        if c.image:
+            kc["image"] = c.image
+        if c.command:
+            kc["command"] = list(c.command)
+        if c.args:
+            kc["args"] = list(c.args)
+        if c.working_dir:
+            kc["workingDir"] = c.working_dir
+        if c.env:
+            kc["env"] = [{"name": k, "value": str(v)}
+                         for k, v in sorted(c.env.items())]
+        if c.ports:
+            kc["ports"] = [{"name": n, "containerPort": int(p)}
+                           for n, p in sorted(c.ports.items())]
+        if c.resources:
+            # Flat resource map -> limits (covers google.com/tpu chip
+            # requests; K8s defaults requests from limits).
+            kc["resources"] = {"limits": dict(c.resources)}
+        containers.append(kc)
+    restart = pod.spec.restart_policy
+    if restart not in _K8S_RESTART_POLICIES:
+        restart = "Never"
+    spec: dict = {"containers": containers, "restartPolicy": restart}
+    if pod.spec.scheduler_name:
+        spec["schedulerName"] = pod.spec.scheduler_name
+    if pod.spec.node_selector:
+        spec["nodeSelector"] = dict(pod.spec.node_selector)
+    if pod.spec.node_name:
+        spec["nodeName"] = pod.spec.node_name
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": _meta_to_k8s(pod.metadata), "spec": spec}
+
+
+def _container_from_k8s(kc: dict) -> Container:
+    env = {e["name"]: e.get("value", "")
+           for e in kc.get("env") or [] if "name" in e}
+    ports = {p.get("name", f"port-{p.get('containerPort')}"):
+             int(p.get("containerPort", 0)) for p in kc.get("ports") or []}
+    resources = dict((kc.get("resources") or {}).get("limits") or {})
+    return Container(name=kc.get("name", ""), image=kc.get("image", ""),
+                     command=list(kc.get("command") or []),
+                     args=list(kc.get("args") or []),
+                     env=env, ports=ports,
+                     resources={k: str(v) for k, v in resources.items()},
+                     working_dir=kc.get("workingDir", ""))
+
+
+def _container_status_from_k8s(cs: dict) -> ContainerStatus:
+    state = cs.get("state") or {}
+    mapped, exit_code, message = "", None, ""
+    if "terminated" in state:
+        mapped = "Terminated"
+        exit_code = state["terminated"].get("exitCode")
+        message = (state["terminated"].get("message")
+                   or state["terminated"].get("reason") or "")
+    elif "running" in state:
+        mapped = "Running"
+    elif "waiting" in state:
+        mapped = "Waiting"
+        message = (state["waiting"].get("message")
+                   or state["waiting"].get("reason") or "")
+    return ContainerStatus(name=cs.get("name", ""), state=mapped,
+                           exit_code=exit_code,
+                           restart_count=int(cs.get("restartCount", 0)),
+                           message=message)
+
+
+def pod_from_k8s(d: dict) -> Pod:
+    spec_d = d.get("spec") or {}
+    status_d = d.get("status") or {}
+    spec = PodSpec(
+        containers=[_container_from_k8s(kc)
+                    for kc in spec_d.get("containers") or []],
+        restart_policy=spec_d.get("restartPolicy", "Never"),
+        scheduler_name=spec_d.get("schedulerName", ""),
+        node_selector=dict(spec_d.get("nodeSelector") or {}),
+        node_name=spec_d.get("nodeName", ""),
+    )
+    status = PodStatus(
+        phase=status_d.get("phase", "Pending"),
+        container_statuses=[_container_status_from_k8s(cs) for cs in
+                            status_d.get("containerStatuses") or []],
+        start_time=parse_time(status_d.get("startTime")),
+        host=status_d.get("podIP") or status_d.get("hostIP") or "",
+        message=status_d.get("message", ""),
+    )
+    return Pod(metadata=_meta_from_k8s(d.get("metadata") or {}),
+               spec=spec, status=status)
+
+
+def service_to_k8s(ep: Endpoint) -> dict:
+    """Per-replica headless Service (reference CreateNewService,
+    common/service.go:277-339: ClusterIP None, selector = that one pod)."""
+    ports = [{"name": n, "port": int(p)}
+             for n, p in sorted(ep.spec.ports.items())]
+    if not ports:
+        ports = [{"name": constants.DEFAULT_PORT_NAME,
+                  "port": constants.DEFAULT_PORT}]
+    return {"apiVersion": "v1", "kind": "Service",
+            "metadata": _meta_to_k8s(ep.metadata),
+            "spec": {"clusterIP": "None",
+                     "selector": dict(ep.spec.selector),
+                     "ports": ports}}
+
+
+def endpoint_from_k8s_service(d: dict) -> Endpoint:
+    spec_d = d.get("spec") or {}
+    ports = {p.get("name", f"port-{p.get('port')}"): int(p.get("port", 0))
+             for p in spec_d.get("ports") or []}
+    return Endpoint(metadata=_meta_from_k8s(d.get("metadata") or {}),
+                    spec=EndpointSpec(selector=dict(spec_d.get("selector")
+                                                    or {}),
+                                      ports=ports))
+
+
+def tpujob_to_k8s(job: TPUJob) -> dict:
+    d = job.to_dict()
+    d["apiVersion"] = constants.API_VERSION
+    d["kind"] = constants.KIND
+    d["metadata"] = _meta_to_k8s(job.metadata)
+    return d
+
+
+def tpujob_from_k8s(d: dict) -> TPUJob:
+    body = dict(d)
+    meta = _meta_from_k8s(d.get("metadata") or {})
+    body.pop("metadata", None)
+    job = TPUJob.from_dict(body)
+    job.metadata = meta
+    return job
+
+
+FROM_K8S: Dict[str, Callable[[dict], object]] = {
+    store_mod.TPUJOBS: tpujob_from_k8s,
+    store_mod.PODS: pod_from_k8s,
+    store_mod.ENDPOINTS: endpoint_from_k8s_service,
+}
+
+
+# ---------------------------------------------------------------------------
+# Controls (reference RealPodControl / RealServiceControl)
+# ---------------------------------------------------------------------------
+
+from tf_operator_tpu.controller.control import (  # noqa: E402
+    EndpointControl,
+    PodControl,
+    controller_owner_ref,
+)
+
+
+class KubePodControl(PodControl):
+    def __init__(self, client: KubeClient, recorder: Recorder):
+        self.client = client
+        self.recorder = recorder
+
+    def create_pod(self, namespace: str, pod: Pod, job: TPUJob) -> None:
+        pod.metadata.namespace = namespace
+        pod.metadata.owner_references = [controller_owner_ref(job)]
+        try:
+            self.client.create(store_mod.PODS, namespace, pod_to_k8s(pod))
+        except Exception as e:
+            self.recorder.event(job, EVENT_TYPE_WARNING, "FailedCreatePod",
+                                f"Error creating: {e}")
+            raise
+        self.recorder.event(job, EVENT_TYPE_NORMAL, "SuccessfulCreatePod",
+                            f"Created pod: {pod.metadata.name}")
+        metrics.created_pods.inc(job_namespace=namespace)
+
+    def delete_pod(self, namespace: str, name: str, job: TPUJob) -> None:
+        try:
+            self.client.delete(store_mod.PODS, namespace, name)
+        except store_mod.NotFoundError:
+            return
+        except Exception as e:
+            self.recorder.event(job, EVENT_TYPE_WARNING, "FailedDeletePod",
+                                f"Error deleting: {e}")
+            raise
+        self.recorder.event(job, EVENT_TYPE_NORMAL, "SuccessfulDeletePod",
+                            f"Deleted pod: {name}")
+        metrics.deleted_pods.inc(job_namespace=namespace)
+
+
+class KubeEndpointControl(EndpointControl):
+    def __init__(self, client: KubeClient, recorder: Recorder):
+        self.client = client
+        self.recorder = recorder
+
+    def create_endpoint(self, namespace: str, endpoint: Endpoint,
+                        job: TPUJob) -> None:
+        endpoint.metadata.namespace = namespace
+        endpoint.metadata.owner_references = [controller_owner_ref(job)]
+        self.client.create(store_mod.ENDPOINTS, namespace,
+                           service_to_k8s(endpoint))
+        metrics.created_endpoints.inc(job_namespace=namespace)
+
+    def delete_endpoint(self, namespace: str, name: str, job: TPUJob) -> None:
+        try:
+            self.client.delete(store_mod.ENDPOINTS, namespace, name)
+        except store_mod.NotFoundError:
+            return
+        metrics.deleted_endpoints.inc(job_namespace=namespace)
+
+
+# ---------------------------------------------------------------------------
+# Informer: cluster state -> Store cache
+# ---------------------------------------------------------------------------
+
+class KubeInformer:
+    """List+watch one kind into the Store (reflector analog). The Store's
+    watch fan-out then drives the controller handlers exactly as the
+    local runtime does."""
+
+    def __init__(self, client: KubeClient, store: Store, kind: str,
+                 namespace: Optional[str] = None,
+                 selector: Optional[Dict[str, str]] = None):
+        self.client = client
+        self.store = store
+        self.kind = kind
+        self.namespace = namespace
+        self.selector = selector
+        self._from_k8s = FROM_K8S[kind]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._resp_box: list = []
+        self.synced = threading.Event()
+
+    def start(self) -> "KubeInformer":
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"informer-{self.kind}",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        # Abort a blocking watch read so shutdown doesn't wait out the
+        # stream timeout.
+        for resp in self._resp_box:
+            try:
+                resp.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                rv = self._relist()
+                self.synced.set()
+                self._watch(rv)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                log.debug("informer %s relisting after error", self.kind,
+                          exc_info=True)
+                self._stop.wait(1.0)
+
+    def _relist(self) -> str:
+        listing = self.client.list(self.kind, self.namespace, self.selector)
+        seen = set()
+        for raw in listing.get("items") or []:
+            obj = self._from_k8s(raw)
+            seen.add((obj.metadata.namespace, obj.metadata.name))
+            self._upsert(obj)
+        # Objects gone from the cluster but still cached: delete.
+        for ns, name, _ in self.store.keys(self.kind):
+            if (ns, name) not in seen:
+                self.store.try_delete(self.kind, ns, name)
+        return str((listing.get("metadata") or {}).get("resourceVersion", "")
+                   or "0")
+
+    def _watch(self, rv: str) -> None:
+        for etype, raw in self.client.watch(self.kind, self.namespace,
+                                            self.selector, rv,
+                                            resp_box=self._resp_box):
+            if self._stop.is_set():
+                return
+            if etype == "BOOKMARK":
+                continue
+            if etype == "ERROR":
+                raise KubeApiError(410, "Expired", "watch expired; relist")
+            obj = self._from_k8s(raw)
+            if etype == "DELETED":
+                self.store.try_delete(self.kind, obj.metadata.namespace,
+                                      obj.metadata.name)
+            else:
+                self._upsert(obj)
+
+    def _upsert(self, obj) -> None:
+        cur = self.store.try_get(self.kind, obj.metadata.namespace,
+                                 obj.metadata.name)
+        if cur is None:
+            try:
+                self.store.create(self.kind, obj)
+            except store_mod.AlreadyExistsError:
+                self._upsert(obj)
+            return
+        # Skip no-op mirrors: a relist re-delivers every object, and an
+        # unconditional update would fire MODIFIED -> enqueue for all.
+        a, b = cur.to_dict(), obj.to_dict()
+        a.get("metadata", {}).pop("resourceVersion", None)
+        b.get("metadata", {}).pop("resourceVersion", None)
+        if a == b:
+            return
+        obj.metadata.resource_version = cur.metadata.resource_version
+        try:
+            self.store.update(self.kind, obj)
+        except (store_mod.ConflictError, store_mod.NotFoundError):
+            pass  # racing mirror; the next event/relist converges
+
+
+# ---------------------------------------------------------------------------
+# Controller + operator assembly
+# ---------------------------------------------------------------------------
+
+from tf_operator_tpu.controller.engine import EngineConfig  # noqa: E402
+from tf_operator_tpu.controller.gang import SliceGangScheduler  # noqa: E402
+from tf_operator_tpu.controller.tpu_controller import (  # noqa: E402
+    TPUJobController,
+)
+
+
+class KubeJobController(TPUJobController):
+    """TPUJobController with the write path against the K8s API server;
+    the Store remains the read cache fed by KubeInformer."""
+
+    def __init__(self, client: KubeClient, store: Optional[Store] = None,
+                 **kwargs):
+        super().__init__(store or Store(), **kwargs)
+        self.client = client
+        self.engine.pod_control = KubePodControl(client, self.recorder)
+        self.engine.endpoint_control = KubeEndpointControl(client,
+                                                           self.recorder)
+
+    def update_job_status_in_api(self, job: TPUJob) -> None:
+        """Status-subresource merge patch (reference
+        UpdateJobStatusInApiServer, tensorflow/status.go:222-240)."""
+        try:
+            self.client.patch(store_mod.TPUJOBS, job.metadata.namespace,
+                              job.metadata.name,
+                              {"status": job.status.to_dict()},
+                              subresource="status")
+        except store_mod.NotFoundError:
+            pass  # job deleted mid-sync
+
+    def delete_job(self, job: TPUJob) -> None:
+        try:
+            self.client.delete(store_mod.TPUJOBS, job.metadata.namespace,
+                               job.metadata.name)
+        except store_mod.NotFoundError:
+            pass
+        self.expectations.delete_for_job(job.key())
+        self.recorder.event(job, EVENT_TYPE_NORMAL, "SuccessfulDeleteJob",
+                            f"Deleted job: {job.metadata.name}")
+
+    def _persist_adoption(self, kind: str, obj):
+        """Ownership patch against the API server (reference AdoptPod's
+        strategic-merge patch, controller_ref_manager.go:208-221)."""
+        ns, name = obj.metadata.namespace, obj.metadata.name
+        # Uncached quorum recheck (reference GetPodsForJob re-reads the
+        # job live before claiming, common/pod.go:241-252): the cache may
+        # lag — the object could have been deleted and recreated (new
+        # uid) or adopted by someone else since the informer mirrored it.
+        try:
+            raw = self.client.get(kind, ns, name)
+        except store_mod.NotFoundError:
+            return None
+        live = FROM_K8S[kind](raw)
+        if (live.metadata.uid != obj.metadata.uid
+                or live.metadata.controller_ref() is not None):
+            return None
+        patch = {"metadata": {
+            # Live resourceVersion precondition closes the GET->PATCH
+            # window (the reference adopt patch carries a uid
+            # precondition for the same race).
+            "resourceVersion": k8s_resource_version(raw),
+            "ownerReferences": [
+                r.to_dict() for r in obj.metadata.owner_references]}}
+        try:
+            raw = self.client.patch(kind, ns, name, patch)
+        except (store_mod.ConflictError, store_mod.NotFoundError):
+            return None
+        return FROM_K8S[kind](raw)
+
+    def _garbage_collect(self, job: TPUJob) -> None:
+        """The cluster's ownerReference GC collects pods/services; delete
+        explicitly too so tests (and clusters with GC lag) converge, and
+        reap the store-local SliceGroup."""
+        for kind in (store_mod.PODS, store_mod.ENDPOINTS):
+            for obj in self.store.list(kind, namespace=job.metadata.namespace):
+                ref = obj.metadata.controller_ref()
+                if ref is not None and ref.uid == job.metadata.uid:
+                    try:
+                        self.client.delete(kind, obj.metadata.namespace,
+                                           obj.metadata.name)
+                    except store_mod.NotFoundError:
+                        pass
+        for obj in self.store.list(store_mod.SLICEGROUPS,
+                                   namespace=job.metadata.namespace):
+            ref = obj.metadata.controller_ref()
+            if ref is not None and ref.uid == job.metadata.uid:
+                self.store.try_delete(store_mod.SLICEGROUPS,
+                                      obj.metadata.namespace,
+                                      obj.metadata.name)
+
+
+class KubeOperator:
+    """Operator assembly against a Kubernetes cluster (the reference
+    deployment shape: manifests/base/deployment.yaml runs exactly this)."""
+
+    def __init__(self, client: KubeClient,
+                 namespace: Optional[str] = None,
+                 enable_gang_scheduling: bool = False,
+                 total_chips: Optional[int] = None,
+                 config: Optional[EngineConfig] = None,
+                 post_events: bool = True):
+        self.client = client
+        self.store = Store()
+        self.post_events = post_events
+        recorder = Recorder(sink=self._post_event if post_events else None)
+        config = config or EngineConfig()
+        gang = None
+        if enable_gang_scheduling:
+            config.enable_gang_scheduling = True
+            gang = SliceGangScheduler(self.store, total_chips=total_chips)
+        self.controller = KubeJobController(client, store=self.store,
+                                            recorder=recorder, config=config,
+                                            gang=gang, namespace=namespace)
+        selector = {constants.LABEL_GROUP_NAME: constants.GROUP}
+        self.informers = [
+            KubeInformer(client, self.store, store_mod.TPUJOBS, namespace),
+            KubeInformer(client, self.store, store_mod.PODS, namespace,
+                         selector),
+            KubeInformer(client, self.store, store_mod.ENDPOINTS, namespace,
+                         selector),
+        ]
+
+    def start(self, threadiness: int = 2,
+              sync_timeout: float = 30.0) -> None:
+        for inf in self.informers:
+            inf.start()
+        # WaitForCacheSync analog (reference controller.go:201).
+        for inf in self.informers:
+            if not inf.synced.wait(timeout=sync_timeout):
+                raise TimeoutError(f"informer {inf.kind} never synced "
+                                   f"(API server unreachable?)")
+        self.controller.run(threadiness=threadiness)
+        log.info("kube operator started (threadiness=%d)", threadiness)
+
+    def stop(self) -> None:
+        self.controller.stop()
+        for inf in self.informers:
+            inf.stop()
+        self.store.stop_watchers()
+
+    def _post_event(self, ev) -> None:
+        """Mirror recorder events as core/v1 Events (reference recorder
+        wiring, common/job_controller.go:158-162)."""
+        import uuid
+
+        ns = ev.namespace or "default"
+        body = {
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": f"{ev.object_name}.{uuid.uuid4().hex[:10]}",
+                         "namespace": ns},
+            "involvedObject": {"kind": ev.object_kind,
+                               "name": ev.object_name, "namespace": ns},
+            "type": ev.type, "reason": ev.reason, "message": ev.message,
+            "source": {"component": "tpu-operator"},
+        }
+        try:
+            self.client.create_event(ns, body)
+        except Exception:
+            log.debug("event post failed", exc_info=True)
+
+
+def check_crd_exists(client: KubeClient) -> bool:
+    """Fail-fast CRD existence probe (reference checkCRDExists,
+    app/server.go:232-251). Only a definitive 404 means "not installed";
+    auth/server errors propagate so they aren't misdiagnosed as a
+    missing CRD."""
+    try:
+        client.request(
+            "GET",
+            f"/apis/apiextensions.k8s.io/v1/customresourcedefinitions/"
+            f"{constants.CRD_NAME}")
+        return True
+    except store_mod.NotFoundError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Leader election over coordination.k8s.io Leases
+# ---------------------------------------------------------------------------
+
+class KubeLeaseStore:
+    """Duck-types the Store subset LeaderElector uses (try_get / create /
+    update on the LEASES kind), backed by coordination.k8s.io/v1 Leases:
+    the cluster-wide lock the reference took on an Endpoints object
+    (app/server.go:168-193) and modern client-go takes on exactly this
+    resource. Optimistic concurrency maps onto resourceVersion'd PUTs."""
+
+    def __init__(self, client: KubeClient):
+        self.client = client
+        # (ns, name) -> raw K8s resourceVersion string for CAS replays.
+        self._rv: Dict[Tuple[str, str], str] = {}
+
+    @staticmethod
+    def _path(ns: str, name: str = "") -> str:
+        base = f"/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
+        return f"{base}/{name}" if name else base
+
+    @staticmethod
+    def _spec_to_k8s(lease) -> dict:
+        import math
+
+        spec = lease.spec.to_dict()
+        # K8s LeaseSpec wants an integer duration; round UP so a
+        # sub-second duration never truncates to an always-expired 0.
+        if spec.get("leaseDurationSeconds") is not None:
+            spec["leaseDurationSeconds"] = math.ceil(
+                spec["leaseDurationSeconds"])
+        return spec
+
+    def _from_k8s(self, raw: dict):
+        from tf_operator_tpu.runtime.leaderelection import Lease
+
+        lease = Lease.from_dict({"spec": raw.get("spec") or {}})
+        lease.metadata = _meta_from_k8s(raw.get("metadata") or {})
+        key = (lease.metadata.namespace, lease.metadata.name)
+        self._rv[key] = k8s_resource_version(raw)
+        return lease
+
+    def try_get(self, kind: str, namespace: str, name: str):
+        try:
+            raw = self.client.request("GET", self._path(namespace, name))
+        except store_mod.NotFoundError:
+            return None
+        return self._from_k8s(raw)
+
+    def create(self, kind: str, lease):
+        ns = lease.metadata.namespace
+        body = {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                "metadata": {"name": lease.metadata.name, "namespace": ns},
+                "spec": self._spec_to_k8s(lease)}
+        return self._from_k8s(
+            self.client.request("POST", self._path(ns), body=body))
+
+    def update(self, kind: str, lease):
+        ns, name = lease.metadata.namespace, lease.metadata.name
+        body = {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                "metadata": {"name": name, "namespace": ns,
+                             "resourceVersion": self._rv.get((ns, name), "")},
+                "spec": self._spec_to_k8s(lease)}
+        return self._from_k8s(
+            self.client.request("PUT", self._path(ns, name), body=body))

@@ -1,0 +1,494 @@
+"""In-process fake Kubernetes API server for backend tests.
+
+The reference tests its K8s write path with generated fake clientsets
+(pkg/client/clientset/versioned/fake/) and its e2e against a live GKE
+cluster. Here the seam sits one level lower — a real HTTP server speaking
+the small API subset KubeClient uses — so the exact production client,
+informer, and controls are exercised byte-for-byte (the kind-cluster
+analog, hermetic and millisecond-fast):
+
+  POST/GET/DELETE/PATCH  /api/v1/namespaces/{ns}/{pods|services|events}
+  GET list (+labelSelector) on namespaced and cluster scope
+  GET ?watch=1 JSON-lines stream (blank-line keepalives)
+  /apis/tpu-operator.dev/v1/.../tpujobs (+ /status subresource patch)
+  PATCH is application/merge-patch+json (RFC 7386)
+
+The fake also plays kubelet: ``set_pod_phase`` fabricates the
+containerStatuses a node would report, which is how tests drive the
+lifecycle (the reference e2e does this through its Flask test-server's
+/exit endpoint; test/test-server/test_app.py:17-60).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import queue as _q
+import threading
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from tf_operator_tpu.api import constants
+
+log = logging.getLogger("tpu_operator.kube_fake")
+
+_KEEPALIVE_SECONDS = 2.0
+
+RESOURCES = ("pods", "services", "events", "leases", constants.PLURAL)
+
+
+def merge_patch(target, patch):
+    """RFC 7386 JSON merge patch."""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = merge_patch(out.get(k), v)
+    return out
+
+
+def _match_selector(labels: Dict[str, str], raw: str) -> bool:
+    if not raw:
+        return True
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        if labels.get(k.strip()) != v.strip():
+            return False
+    return True
+
+
+def _status_body(code: int, reason: str, message: str) -> dict:
+    """core/v1 Status error shape real API servers return."""
+    return {"kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "message": message, "reason": reason, "code": code}
+
+
+class _HttpError(Exception):
+    def __init__(self, code: int, reason: str, message: str):
+        super().__init__(message)
+        self.code = code
+        self.reason = reason
+        self.message = message
+
+
+class FakeKubeState:
+    """The etcd analog: objects + watch fan-out, shared by all handler
+    threads."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        # resource -> {(ns, name) -> dict}
+        self.objects: Dict[str, Dict[Tuple[str, str], dict]] = {
+            r: {} for r in RESOURCES}
+        self._rv = 0
+        # (resource, queue) watch subscriptions
+        self._watchers: List[Tuple[str, "_q.Queue"]] = []
+
+    def next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    # -- CRUD (all under lock) --------------------------------------------
+
+    def create(self, resource: str, ns: str, obj: dict) -> dict:
+        with self.lock:
+            name = (obj.get("metadata") or {}).get("name", "")
+            if not name:
+                raise _HttpError(400, "Invalid", "metadata.name required")
+            key = (ns, name)
+            if key in self.objects[resource]:
+                raise _HttpError(409, "AlreadyExists",
+                                 f"{resource} {ns}/{name} already exists")
+            obj = json.loads(json.dumps(obj))  # detach
+            meta = obj.setdefault("metadata", {})
+            meta["namespace"] = ns
+            meta.setdefault("uid", str(uuid.uuid4()))
+            meta["resourceVersion"] = self.next_rv()
+            meta.setdefault("creationTimestamp",
+                            _dt.datetime.now(_dt.timezone.utc)
+                            .strftime("%Y-%m-%dT%H:%M:%SZ"))
+            if resource == "pods":
+                obj.setdefault("status", {"phase": "Pending"})
+            self.objects[resource][key] = obj
+            self._notify(resource, "ADDED", obj)
+            return json.loads(json.dumps(obj))
+
+    def get(self, resource: str, ns: str, name: str) -> dict:
+        with self.lock:
+            obj = self.objects[resource].get((ns, name))
+            if obj is None:
+                raise _HttpError(404, "NotFound",
+                                 f"{resource} {ns}/{name} not found")
+            return json.loads(json.dumps(obj))
+
+    def delete(self, resource: str, ns: str, name: str) -> dict:
+        with self.lock:
+            obj = self.objects[resource].pop((ns, name), None)
+            if obj is None:
+                raise _HttpError(404, "NotFound",
+                                 f"{resource} {ns}/{name} not found")
+            self._notify(resource, "DELETED", obj)
+            return _status_body(200, "Deleted", f"{name} deleted") | {
+                "status": "Success"}
+
+    def patch(self, resource: str, ns: str, name: str, patch: dict,
+              subresource: str = "") -> dict:
+        with self.lock:
+            cur = self.objects[resource].get((ns, name))
+            if cur is None:
+                raise _HttpError(404, "NotFound",
+                                 f"{resource} {ns}/{name} not found")
+            # resourceVersion in a patch is an optimistic-concurrency
+            # precondition (real apiserver semantics).
+            want_rv = (patch.get("metadata") or {}).get("resourceVersion")
+            cur_rv = (cur.get("metadata") or {}).get("resourceVersion", "")
+            if want_rv and want_rv != cur_rv:
+                raise _HttpError(409, "Conflict",
+                                 f"resourceVersion {want_rv} != {cur_rv}")
+            if subresource == "status":
+                # Status subresource: only .status merges.
+                patch = {"status": patch.get("status")}
+            merged = merge_patch(cur, patch)
+            meta = merged.setdefault("metadata", {})
+            meta["name"], meta["namespace"] = name, ns
+            meta["uid"] = (cur.get("metadata") or {}).get("uid", "")
+            meta["resourceVersion"] = self.next_rv()
+            self.objects[resource][(ns, name)] = merged
+            self._notify(resource, "MODIFIED", merged)
+            return json.loads(json.dumps(merged))
+
+    def replace(self, resource: str, ns: str, name: str, obj: dict) -> dict:
+        """PUT with optimistic concurrency: a stale resourceVersion loses
+        the race (the CAS leader election depends on)."""
+        with self.lock:
+            cur = self.objects[resource].get((ns, name))
+            if cur is None:
+                raise _HttpError(404, "NotFound",
+                                 f"{resource} {ns}/{name} not found")
+            rv = (obj.get("metadata") or {}).get("resourceVersion", "")
+            cur_rv = (cur.get("metadata") or {}).get("resourceVersion", "")
+            if rv and rv != cur_rv:
+                raise _HttpError(409, "Conflict",
+                                 f"resourceVersion {rv} != {cur_rv}")
+            obj = json.loads(json.dumps(obj))
+            meta = obj.setdefault("metadata", {})
+            meta["name"], meta["namespace"] = name, ns
+            meta["uid"] = (cur.get("metadata") or {}).get("uid", "")
+            meta["creationTimestamp"] = (cur.get("metadata") or {}).get(
+                "creationTimestamp", "")
+            meta["resourceVersion"] = self.next_rv()
+            self.objects[resource][(ns, name)] = obj
+            self._notify(resource, "MODIFIED", obj)
+            return json.loads(json.dumps(obj))
+
+    def list(self, resource: str, ns: Optional[str],
+             selector: str) -> dict:
+        with self.lock:
+            items = []
+            for (ons, _), obj in self.objects[resource].items():
+                if ns is not None and ons != ns:
+                    continue
+                labels = (obj.get("metadata") or {}).get("labels") or {}
+                if not _match_selector(labels, selector):
+                    continue
+                items.append(json.loads(json.dumps(obj)))
+            return {"kind": "List", "apiVersion": "v1",
+                    "metadata": {"resourceVersion": str(self._rv)},
+                    "items": items}
+
+    # -- watch -------------------------------------------------------------
+
+    def subscribe(self, resource: str) -> "_q.Queue":
+        q: "_q.Queue" = _q.Queue()
+        with self.lock:
+            self._watchers.append((resource, q))
+        return q
+
+    def unsubscribe(self, q: "_q.Queue") -> None:
+        with self.lock:
+            self._watchers = [(r, w) for r, w in self._watchers if w is not q]
+
+    def _notify(self, resource: str, etype: str, obj: dict) -> None:
+        payload = json.loads(json.dumps(obj))
+        for r, q in self._watchers:
+            if r == resource:
+                q.put((etype, payload))
+
+    # -- fake kubelet ------------------------------------------------------
+
+    def set_pod_phase(self, ns: str, name: str, phase: str,
+                      exit_code: Optional[int] = None,
+                      restart_count: int = 0) -> None:
+        """Fabricate the node's status report for a pod."""
+        with self.lock:
+            pod = self.objects["pods"].get((ns, name))
+            if pod is None:
+                raise _HttpError(404, "NotFound", f"pod {ns}/{name} not found")
+            containers = (pod.get("spec") or {}).get("containers") or []
+            statuses = []
+            for c in containers:
+                if phase in ("Succeeded", "Failed"):
+                    code = exit_code if exit_code is not None else (
+                        0 if phase == "Succeeded" else 1)
+                    state = {"terminated": {"exitCode": code}}
+                elif phase == "Running":
+                    state = {"running": {}}
+                else:
+                    state = {"waiting": {"reason": "ContainerCreating"}}
+                statuses.append({"name": c.get("name", ""), "state": state,
+                                 "restartCount": restart_count})
+            self.patch("pods", ns, name,
+                       {"status": {"phase": phase, "hostIP": "10.0.0.1",
+                                   "containerStatuses": statuses}},
+                       subresource="status")
+
+    def set_all_pods_phase(self, ns: str, phase: str, *,
+                           selector: Optional[Dict[str, str]] = None) -> int:
+        raw = ",".join(f"{k}={v}" for k, v in (selector or {}).items())
+        with self.lock:
+            names = [name for (ons, name), obj in self.objects["pods"].items()
+                     if ons == ns and _match_selector(
+                         (obj.get("metadata") or {}).get("labels") or {},
+                         raw)]
+        for name in names:
+            self.set_pod_phase(ns, name, phase)
+        return len(names)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "fake-kube-apiserver"
+    state: FakeKubeState
+
+    def log_message(self, fmt, *args):
+        log.debug("%s %s", self.address_string(), fmt % args)
+
+    # -- routing -----------------------------------------------------------
+
+    def _route(self):
+        """-> (resource, ns_or_None, name, subresource, query)."""
+        parsed = urllib.parse.urlsplit(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        query = {k: v[0] for k, v in
+                 urllib.parse.parse_qs(parsed.query).items()}
+        # /api/v1/... (core) or /apis/{group}/{version}/... (CRs)
+        if parts[:2] == ["api", "v1"]:
+            rest = parts[2:]
+        elif (parts[:3] == ["apis", constants.GROUP, constants.VERSION]):
+            rest = parts[3:]
+        elif parts[:3] == ["apis", "coordination.k8s.io", "v1"]:
+            rest = parts[3:]
+        elif (parts[:3] == ["apis", "apiextensions.k8s.io", "v1"]
+              and parts[3:4] == ["customresourcedefinitions"]):
+            # CRD existence probe: report installed.
+            name = parts[4] if len(parts) > 4 else ""
+            if name and name != constants.CRD_NAME:
+                raise _HttpError(404, "NotFound", f"CRD {name} not found")
+            return "_crd_probe", None, name, "", query
+        else:
+            raise _HttpError(404, "NotFound", f"no route {self.path}")
+        ns = None
+        if rest[:1] == ["namespaces"] and len(rest) >= 3:
+            ns = rest[1]
+            rest = rest[2:]
+        if not rest:
+            raise _HttpError(404, "NotFound", f"no route {self.path}")
+        resource, rest = rest[0], rest[1:]
+        if resource not in RESOURCES:
+            raise _HttpError(404, "NotFound", f"unknown resource {resource}")
+        name = rest[0] if rest else ""
+        sub = rest[1] if len(rest) > 1 else ""
+        return resource, ns, name, sub, query
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", "0") or "0")
+        raw = self.rfile.read(length) if length else b"{}"
+        try:
+            return json.loads(raw or b"{}")
+        except json.JSONDecodeError as e:
+            raise _HttpError(400, "Invalid", f"bad JSON: {e}")
+
+    def _guard(self, fn):
+        try:
+            fn()
+        except _HttpError as e:
+            try:
+                self._send_json(e.code, _status_body(e.code, e.reason,
+                                                     e.message))
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    # -- verbs -------------------------------------------------------------
+
+    def do_GET(self):
+        def run():
+            resource, ns, name, _, query = self._route()
+            if resource == "_crd_probe":
+                return self._send_json(200, {
+                    "kind": "CustomResourceDefinition",
+                    "metadata": {"name": constants.CRD_NAME}})
+            if name:
+                return self._send_json(200,
+                                       self.state.get(resource, ns or
+                                                      "default", name))
+            if query.get("watch") in ("1", "true"):
+                return self._serve_watch(resource, ns, query)
+            return self._send_json(200, self.state.list(
+                resource, ns, query.get("labelSelector", "")))
+        self._guard(run)
+
+    def do_POST(self):
+        def run():
+            resource, ns, name, _, _q2 = self._route()
+            if name:
+                raise _HttpError(405, "MethodNotAllowed", "POST to item")
+            self._send_json(201, self.state.create(resource, ns or "default",
+                                                   self._read_body()))
+        self._guard(run)
+
+    def do_DELETE(self):
+        def run():
+            resource, ns, name, _, _q2 = self._route()
+            if not name:
+                raise _HttpError(405, "MethodNotAllowed", "DELETE collection")
+            self._send_json(200, self.state.delete(resource, ns or "default",
+                                                   name))
+        self._guard(run)
+
+    def do_PUT(self):
+        def run():
+            resource, ns, name, _, _q2 = self._route()
+            if not name:
+                raise _HttpError(405, "MethodNotAllowed", "PUT collection")
+            self._send_json(200, self.state.replace(resource, ns or "default",
+                                                    name, self._read_body()))
+        self._guard(run)
+
+    def do_PATCH(self):
+        def run():
+            resource, ns, name, sub, _q2 = self._route()
+            if not name:
+                raise _HttpError(405, "MethodNotAllowed", "PATCH collection")
+            ctype = self.headers.get("Content-Type", "")
+            if "merge-patch" not in ctype and "strategic" not in ctype:
+                raise _HttpError(415, "UnsupportedMediaType",
+                                 f"unsupported patch type {ctype}")
+            self._send_json(200, self.state.patch(resource, ns or "default",
+                                                  name, self._read_body(),
+                                                  subresource=sub))
+        self._guard(run)
+
+    # -- watch -------------------------------------------------------------
+
+    def _serve_watch(self, resource: str, ns: Optional[str], query) -> None:
+        import time as _time
+
+        selector = query.get("labelSelector", "")
+        q = self.state.subscribe(resource)
+        # Replay every object newer than the client's resourceVersion as
+        # ADDED — the subscribe-after-list race means events landing
+        # between the client's list and this subscription would otherwise
+        # be lost until a relist that never comes. (A real apiserver
+        # serves these from its event history.) rv "0" replays all.
+        rv = query.get("resourceVersion", "") or "0"
+        try:
+            rv_num = int(rv)
+        except ValueError:
+            rv_num = 0
+        for item in self.state.list(resource, ns, selector)["items"]:
+            try:
+                item_rv = int((item.get("metadata") or {})
+                              .get("resourceVersion", "0"))
+            except ValueError:
+                item_rv = 0
+            if item_rv > rv_num or rv_num == 0:
+                q.put(("ADDED", item))
+        # Honor timeoutSeconds: real watches expire and clients relist,
+        # which is also the fake's backstop for window-lost deletions.
+        try:
+            deadline = _time.monotonic() + float(
+                query.get("timeoutSeconds", "300"))
+        except ValueError:
+            deadline = _time.monotonic() + 300.0
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            while _time.monotonic() < deadline:
+                try:
+                    etype, obj = q.get(timeout=_KEEPALIVE_SECONDS)
+                except _q.Empty:
+                    self.wfile.write(b"\n")
+                    self.wfile.flush()
+                    continue
+                meta = obj.get("metadata") or {}
+                if ns is not None and meta.get("namespace") != ns:
+                    continue
+                if not _match_selector(meta.get("labels") or {}, selector):
+                    continue
+                line = json.dumps({"type": etype, "object": obj})
+                self.wfile.write(line.encode() + b"\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.state.unsubscribe(q)
+
+
+class FakeKubeApiServer:
+    """Serve a FakeKubeState over HTTP on a background thread."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.state = FakeKubeState()
+        handler = type("BoundHandler", (_Handler,), {"state": self.state})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FakeKubeApiServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="fake-kube", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "FakeKubeApiServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
