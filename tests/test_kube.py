@@ -343,12 +343,29 @@ class TestKubeOperator:
             ("default", "aj-worker-extra")) is None,
             msg="adopted orphan deleted as out-of-range")
 
+    def test_relabeled_pod_released_via_patch(self, client, fake, operator):
+        client.create(store_mod.TPUJOBS, "default", make_job(name="rl",
+                                                             workers=1))
+        wait_for(lambda: len(self._pods(fake)) == 1, msg="pod created")
+        # The pod's labels stop matching the job selector: the controller
+        # must patch its ownerReference away (release), not delete it.
+        client.patch(store_mod.PODS, "default", "rl-worker-0",
+                     {"metadata": {"labels": {"job-name": "quarantine"}}})
+
+        def released():
+            raw = fake.state.get("pods", "default", "rl-worker-0")
+            return not (raw.get("metadata") or {}).get("ownerReferences")
+
+        wait_for(released, msg="ownerReferences patched away")
+        assert fake.state.get("pods", "default", "rl-worker-0")  # not deleted
+
     def test_job_delete_cascades(self, client, fake, operator):
         client.create(store_mod.TPUJOBS, "default", make_job(name="dj",
                                                              workers=2))
         wait_for(lambda: len(self._pods(fake)) == 2, msg="pods created")
         client.delete(store_mod.TPUJOBS, "default", "dj")
-        wait_for(lambda: not self._pods(fake), msg="pods garbage-collected")
+        wait_for(lambda: not self._pods(fake), timeout=20,
+                 msg="pods garbage-collected")
         assert not fake.state.list("services", "default", "")["items"]
 
 

@@ -342,34 +342,53 @@ class TPUJobController(JobPlugin):
         }
 
     def get_pods_for_job(self, job: TPUJob) -> List[Pod]:
-        """List + adopt/release (reference GetPodsForJob common/pod.go:219-254
-        with ControllerRefManager claim semantics)."""
+        """List ALL pods in the namespace, then claim: the namespace-wide
+        list (not a selector list) is what lets the manager see owned
+        pods whose labels stopped matching, so it can release them
+        (reference GetPodsForJob common/pod.go:219-254 +
+        ControllerRefManager claim semantics)."""
         pods = self.store.list(store_mod.PODS,
-                               namespace=job.metadata.namespace,
-                               selector=self._base_selector(job))
+                               namespace=job.metadata.namespace)
         return self._claim(store_mod.PODS, job, pods)
 
     def get_endpoints_for_job(self, job: TPUJob) -> List[Endpoint]:
         eps = self.store.list(store_mod.ENDPOINTS,
-                              namespace=job.metadata.namespace,
-                              selector=self._base_selector(job))
+                              namespace=job.metadata.namespace)
         return self._claim(store_mod.ENDPOINTS, job, eps)
 
     def _claim(self, kind: str, job: TPUJob, objs):
-        """Adopt matching orphans; skip objects owned by someone else
-        (reference controller_ref_manager.go:169-223)."""
+        """Full ControllerRefManager semantics (reference
+        controller_ref_manager.go:169-299 ClaimPods/ClaimObject):
+
+        - matching orphan        -> adopt (unless the job is terminating)
+        - owned + matching       -> keep
+        - owned + NOT matching   -> release (drop our ownerReference so
+          another controller — or nobody — can claim it; the pod itself
+          is left alone)
+        - someone else's         -> ignore
+        """
+        selector = self._base_selector(job)
         claimed = []
         for obj in objs:
             ref = obj.metadata.controller_ref()
+            matches = store_mod.matches_selector(obj.metadata.labels,
+                                                 selector)
             if ref is None:
-                if job.metadata.deletion_timestamp is not None:
+                if not matches or job.metadata.deletion_timestamp is not None:
                     continue
                 obj.metadata.owner_references.append(controller_owner_ref(job))
                 obj = self._persist_adoption(kind, obj)
                 if obj is not None:
                     claimed.append(obj)
             elif ref.uid == job.metadata.uid:
-                claimed.append(obj)
+                if matches:
+                    claimed.append(obj)
+                elif job.metadata.deletion_timestamp is None:
+                    # Reference ReleasePod (controller_ref_manager.go:223).
+                    # A terminating job must NOT release: stripping the
+                    # ownerReference mid-deletion would orphan the pod
+                    # past every garbage collector, leaking it forever.
+                    self._persist_release(kind, obj, job)
             # else: owned by another controller -> leave it alone
         return claimed
 
@@ -382,6 +401,18 @@ class TPUJobController(JobPlugin):
             return self.store.update(kind, obj)
         except (store_mod.ConflictError, store_mod.NotFoundError):
             return None
+
+    def _persist_release(self, kind: str, obj, job: TPUJob) -> None:
+        """Drop this job's ownerReference from the object (reference
+        ReleasePod's owner-delete patch; NotFound/Conflict are benign —
+        deleted means released, changed means retry next sync)."""
+        obj.metadata.owner_references = [
+            r for r in obj.metadata.owner_references
+            if r.uid != job.metadata.uid]
+        try:
+            self.store.update(kind, obj)
+        except (store_mod.ConflictError, store_mod.NotFoundError):
+            pass
 
     def delete_job(self, job: TPUJob) -> None:
         """Reference DeleteJob (tensorflow/job.go:39-55)."""

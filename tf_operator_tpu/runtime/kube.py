@@ -755,6 +755,27 @@ class KubeJobController(TPUJobController):
             return None
         return FROM_K8S[kind](raw)
 
+    def _persist_release(self, kind: str, obj, job: TPUJob) -> None:
+        """ReleasePod analog against the API server: live-read, verify
+        the same object still exists, then patch our ownerReference away
+        under a resourceVersion precondition."""
+        ns, name = obj.metadata.namespace, obj.metadata.name
+        try:
+            raw = self.client.get(kind, ns, name)
+        except store_mod.NotFoundError:
+            return  # deleted is released
+        live = FROM_K8S[kind](raw)
+        if live.metadata.uid != obj.metadata.uid:
+            return  # recreated under the same name; not ours to touch
+        refs = [r.to_dict() for r in live.metadata.owner_references
+                if r.uid != job.metadata.uid]
+        patch = {"metadata": {"resourceVersion": k8s_resource_version(raw),
+                              "ownerReferences": refs}}
+        try:
+            self.client.patch(kind, ns, name, patch)
+        except (store_mod.ConflictError, store_mod.NotFoundError):
+            pass  # changed underneath us; the next sync reconverges
+
     def _garbage_collect(self, job: TPUJob) -> None:
         """The cluster's ownerReference GC collects pods/services; delete
         explicitly too so tests (and clusters with GC lag) converge, and
@@ -799,13 +820,15 @@ class KubeOperator:
         self.controller = KubeJobController(client, store=self.store,
                                             recorder=recorder, config=config,
                                             gang=gang, namespace=namespace)
-        selector = {constants.LABEL_GROUP_NAME: constants.GROUP}
+        # Pods/services are watched UNSELECTED (upstream controller
+        # style): a selector watch would drop an owned pod from the cache
+        # the moment its group label is edited away, making it invisible
+        # to the release path and leaving a stale ownerReference to
+        # cascade-delete it later.
         self.informers = [
             KubeInformer(client, self.store, store_mod.TPUJOBS, namespace),
-            KubeInformer(client, self.store, store_mod.PODS, namespace,
-                         selector),
-            KubeInformer(client, self.store, store_mod.ENDPOINTS, namespace,
-                         selector),
+            KubeInformer(client, self.store, store_mod.PODS, namespace),
+            KubeInformer(client, self.store, store_mod.ENDPOINTS, namespace),
         ]
 
     def start(self, threadiness: int = 2,

@@ -40,7 +40,8 @@ class AlreadyExistsError(Exception):
     pass
 
 
-def _matches(labels: Dict[str, str], selector: Dict[str, str]) -> bool:
+def matches_selector(labels: Dict[str, str],
+                     selector: Dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
@@ -122,7 +123,8 @@ class Store:
             for (ns, _), obj in self._objects.get(kind, {}).items():
                 if namespace is not None and ns != namespace:
                     continue
-                if selector and not _matches(obj.metadata.labels, selector):
+                if selector and not matches_selector(obj.metadata.labels,
+                                                     selector):
                     continue
                 out.append(obj.deepcopy())
             return out
