@@ -342,18 +342,21 @@ class TPUJobController(JobPlugin):
         }
 
     def get_pods_for_job(self, job: TPUJob) -> List[Pod]:
-        """List ALL pods in the namespace, then claim: the namespace-wide
-        list (not a selector list) is what lets the manager see owned
-        pods whose labels stopped matching, so it can release them
+        """List-then-claim; the view must include owned pods whose
+        labels stopped matching so the manager can release them
         (reference GetPodsForJob common/pod.go:219-254 +
-        ControllerRefManager claim semantics)."""
-        pods = self.store.list(store_mod.PODS,
-                               namespace=job.metadata.namespace)
+        ControllerRefManager claim semantics). ``list_claimable``
+        filters store-side — selector match OR owned-by-this-job —
+        so unrelated objects are never deepcopied per sync."""
+        pods = self.store.list_claimable(
+            store_mod.PODS, job.metadata.namespace,
+            self._base_selector(job), job.metadata.uid)
         return self._claim(store_mod.PODS, job, pods)
 
     def get_endpoints_for_job(self, job: TPUJob) -> List[Endpoint]:
-        eps = self.store.list(store_mod.ENDPOINTS,
-                              namespace=job.metadata.namespace)
+        eps = self.store.list_claimable(
+            store_mod.ENDPOINTS, job.metadata.namespace,
+            self._base_selector(job), job.metadata.uid)
         return self._claim(store_mod.ENDPOINTS, job, eps)
 
     def _claim(self, kind: str, job: TPUJob, objs):

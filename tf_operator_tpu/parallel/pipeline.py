@@ -2,22 +2,39 @@
 
 The reference has no pipeline parallelism anywhere (SURVEY §2.3 —
 TP/PP/SP/EP absent); in this framework it is a harness feature, built
-the TPU-idiomatic way: an explicit GPipe-style microbatch schedule
-inside ``shard_map``, with activations handed to the next stage by
+the TPU-idiomatic way: explicit microbatch schedules inside
+``shard_map``, with activations handed to the next stage by
 ``ppermute`` (ICI neighbor transfers), not a port of any
 send/recv-thread design.
+
+Two schedules:
+
+- **GPipe** (``pipeline_apply``/``pipeline_sharded``): forward-only
+  scan; the backward schedule falls out of autodiff. Simple, but scan
+  autodiff stashes one activation per step — O(m) microbatch residuals
+  per rank — and the default all-gather of outputs broadcasts the full
+  activation tensor around the ring.
+- **1F1B** (``pipeline_train_sharded``): a fused forward+backward
+  schedule with a manual VJP. Each tick runs one (masked) forward and
+  one (masked) recompute-backward; stage s starts microbatch j's
+  forward at tick s+j and its backward at tick 2(pp-1)-s+j, so a
+  residual needs to live only 2(pp-1-s) ticks — a ring buffer of
+  depth 2·pp bounds activation memory at O(pp) microbatches per rank
+  regardless of m (the 1F1B memory property). Only the scalar loss
+  crosses stages at the end (psum of one number); the full output
+  tensor is never broadcast. Backward recomputes the stage forward
+  from the stashed input (remat-style), so per-microbatch compute is
+  1 fwd + ~2 bwd units, the same as GPipe-with-remat.
 
 How it maps to hardware:
 - each pp rank holds one *stage* (a contiguous chunk of layers whose
   params carry a leading stage axis sharded over ``pp``);
-- one scan step = every stage computes its microbatch then ppermutes
-  the activation ring-forward; XLA overlaps the permute with the next
-  step's compute (async collective);
-- the schedule runs ``num_microbatches + pp - 1`` steps; the ``pp - 1``
-  bubble steps compute garbage that is masked out of the output. Bubble
-  fraction = (pp-1)/(m+pp-1): amortize with more microbatches;
-- everything is ``lax.scan`` + ``ppermute`` — differentiable, so the
-  backward pipeline schedule falls out of autodiff for free.
+- one scan tick = masked stage compute(s), then ppermute: activations
+  ring-forward, cotangents ring-backward; XLA overlaps the permutes
+  with the next tick's compute (async collectives);
+- bubble: GPipe runs m+pp-1 forward ticks (fraction (pp-1)/(m+pp-1));
+  1F1B runs m+2(pp-1) fused ticks. Amortize with more microbatches —
+  measured curves in benchmarks/bench_pipeline.py.
 """
 
 from __future__ import annotations
@@ -53,11 +70,15 @@ def merge_microbatches(x: jax.Array) -> jax.Array:
 
 def pipeline_apply(stage_fn: StageFn, stage_params: Any,
                    microbatches: jax.Array,
-                   axis_name: str = "pp") -> jax.Array:
+                   axis_name: str = "pp",
+                   gather_output: bool = True) -> jax.Array:
     """GPipe schedule; call inside shard_map (stage_params = this rank's
     stage, microbatches [m, mb, ...] identical on every pp rank).
 
-    Returns the full [m, mb, ...] outputs on every pp rank.
+    With ``gather_output`` the [m, mb, ...] outputs are replicated to
+    every pp rank (a ring-wide psum of the full tensor — convenient but
+    expensive); without it they are valid on the LAST stage only (zeros
+    elsewhere), for callers that reduce to a scalar there.
     """
     n_stages = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
@@ -89,11 +110,15 @@ def pipeline_apply(stage_fn: StageFn, stage_params: Any,
     out0 = jnp.zeros_like(microbatches)
     (_, outputs), _ = lax.scan(step, (state0, out0),
                                jnp.arange(m + n_stages - 1))
-    # Outputs are only valid on the last stage; replicate them across the
-    # ring so downstream (loss) code is rank-agnostic.
+    # Outputs are only valid on the last stage.
     outputs = jnp.where(stage == n_stages - 1, outputs,
                         jnp.zeros_like(outputs))
-    return lax.psum(outputs, axis_name)
+    if gather_output:
+        # Replicate across the ring so downstream (loss) code is
+        # rank-agnostic — full-tensor traffic; prefer the 1F1B trainer
+        # (scalar-only reduction) for training steps.
+        outputs = lax.psum(outputs, axis_name)
+    return outputs
 
 
 def pipeline_sharded(stage_fn: StageFn, stacked_params: Any, x: jax.Array,
@@ -127,3 +152,137 @@ def stack_stage_params(per_stage_params: list) -> Any:
     axis on every leaf (the layout pipeline_sharded expects)."""
     return jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B training schedule (manual VJP, O(pp) activation memory)
+# ---------------------------------------------------------------------------
+
+# loss_fn(y, targets) -> scalar mean loss for one microbatch.
+LossFn = Callable[[jax.Array, jax.Array], jax.Array]
+
+
+def pipeline_train_1f1b(stage_fn: StageFn, loss_fn: LossFn,
+                        stage_params: Any, microbatches: jax.Array,
+                        targets: jax.Array, n_stages: int,
+                        axis_name: str = "pp"):
+    """Fused forward/backward pipeline; call inside shard_map.
+
+    Schedule (tick = one scan step; both slots run masked every tick):
+      forward of microbatch j at stage s  -> tick  s + j
+      backward of microbatch j at stage s -> tick  2(pp-1) - s + j
+    so the last stage backwards j in the same tick it forwards it, the
+    cotangent rides the reverse ring one stage per tick, and stage s
+    holds at most 2(pp-1-s) live residuals — the ring buffer of depth
+    2·pp makes activation memory independent of the microbatch count.
+
+    Returns (mean loss, grads for THIS rank's stage). Only the scalar
+    loss is psum'd; gradients stay stage-sharded.
+    """
+    pp = n_stages
+    stage = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    ring_depth = 2 * pp
+    ticks = m + 2 * (pp - 1)
+    fwd_ring = [(i, (i + 1) % pp) for i in range(pp)]
+    bwd_ring = [(i, (i - 1) % pp) for i in range(pp)]
+
+    def mb_at(arr, j):
+        return lax.dynamic_index_in_dim(arr, jnp.clip(j, 0, m - 1),
+                                        axis=0, keepdims=False)
+
+    grads0 = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
+    ring0 = jnp.zeros((ring_depth,) + microbatches.shape[1:],
+                      microbatches.dtype)
+    state0 = jnp.zeros_like(microbatches[0])
+
+    def step(carry, t):
+        fwd_state, bwd_state, ring, grads, loss_sum = carry
+
+        # -- forward slot: microbatch fj enters this stage ---------------
+        fj = t - stage
+        fwd_valid = jnp.logical_and(fj >= 0, fj < m)
+        x_in = jnp.where(stage == 0, mb_at(microbatches, fj), fwd_state)
+        y = stage_fn(stage_params, x_in)
+        # Stash the stage INPUT (the backward recomputes the forward
+        # from it, remat-style); masked write keeps stale slots intact.
+        slot = jnp.clip(fj, 0, m - 1) % ring_depth
+        old = lax.dynamic_index_in_dim(ring, slot, axis=0, keepdims=False)
+        ring = lax.dynamic_update_index_in_dim(
+            ring, jnp.where(fwd_valid, x_in, old), slot, axis=0)
+
+        # -- backward slot: microbatch bj leaves this stage --------------
+        bj = t - 2 * (pp - 1) + stage
+        bwd_valid = jnp.logical_and(bj >= 0, bj < m)
+        bslot = jnp.clip(bj, 0, m - 1) % ring_depth
+        x_res = lax.dynamic_index_in_dim(ring, bslot, axis=0,
+                                         keepdims=False)
+        y_re, vjp_fn = jax.vjp(stage_fn, stage_params, x_res)
+        t_mb = mb_at(targets, bj)
+        loss_val, dy_last = jax.value_and_grad(
+            lambda yy: loss_fn(yy, t_mb))(y_re)
+        dy = jnp.where(stage == pp - 1, dy_last, bwd_state)
+        dparams, dx = vjp_fn(dy)
+        # Select, don't multiply-by-zero: bubble ticks run the backward
+        # on garbage residuals, and 0·NaN would poison every real
+        # gradient (e.g. log-losses on zeroed ring slots).
+        grads = jax.tree_util.tree_map(
+            lambda g, d: g + jnp.where(bwd_valid, d, jnp.zeros_like(d)),
+            grads, dparams)
+        loss_sum = loss_sum + jnp.where(
+            jnp.logical_and(bwd_valid, stage == pp - 1),
+            loss_val.astype(jnp.float32), 0.0)
+
+        # -- ring handoffs (XLA overlaps with next tick's compute) -------
+        fwd_state = lax.ppermute(y, axis_name, fwd_ring)
+        bwd_state = lax.ppermute(dx, axis_name, bwd_ring)
+        return (fwd_state, bwd_state, ring, grads, loss_sum), None
+
+    carry0 = (state0, jnp.zeros_like(state0), ring0, grads0,
+              jnp.zeros((), jnp.float32))
+    (_, _, _, grads, loss_sum), _ = lax.scan(step, carry0,
+                                             jnp.arange(ticks))
+    # Mean over microbatches; scalar is the ONLY cross-stage output.
+    loss = lax.psum(loss_sum / m, axis_name)
+    grads = jax.tree_util.tree_map(lambda g: g / m, grads)
+    return loss, grads
+
+
+def pipeline_train_sharded(stage_fn: StageFn, loss_fn: LossFn,
+                           stacked_params: Any, x: jax.Array,
+                           targets: jax.Array, mesh: Mesh,
+                           num_microbatches: int,
+                           axis_name: str = "pp"):
+    """Global-view 1F1B training step: returns (mean loss, grads with
+    the leading [pp] stage axis, sharded like ``stacked_params``).
+
+    Compose with an optimizer for a full PP training step; the loss is
+    replicated, gradients never leave their stage.
+    """
+    n_stages = mesh.shape[axis_name]
+    batch_axes = data_axes(mesh)
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    xspec = P(None, batch_axes)
+
+    def inner(params, mb, tgt):
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        loss, grads = pipeline_train_1f1b(stage_fn, loss_fn, local, mb,
+                                          tgt, n_stages,
+                                          axis_name=axis_name)
+        # Average grads over the data axes (each dp shard saw its own
+        # microbatches), mirroring the usual DP all-reduce.
+        if batch_axes:
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, batch_axes), grads)
+            loss = lax.pmean(loss, batch_axes)
+        # Re-attach the stage axis for the global [pp, ...] layout.
+        grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+        return loss, grads
+
+    fn = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(pspec, xspec, xspec),
+        out_specs=(P(), pspec),
+        check_vma=False)
+    return fn(stacked_params, split_microbatches(x, num_microbatches),
+              split_microbatches(targets, num_microbatches))

@@ -180,6 +180,21 @@ class RemoteStore:
         cls = self._cls(kind)
         return [cls.from_dict(item) for item in data.get("items", [])]
 
+    def list_claimable(self, kind: str, namespace: str,
+                       selector: Dict[str, str],
+                       owner_uid: str) -> List[object]:
+        """Store.list_claimable parity for duck-typed consumers: label
+        match OR owned by ``owner_uid`` (client-side filter over the
+        namespace listing)."""
+        out = []
+        for obj in self.list(kind, namespace=namespace):
+            if not store_mod.matches_selector(obj.metadata.labels, selector):
+                ref = obj.metadata.controller_ref()
+                if ref is None or ref.uid != owner_uid:
+                    continue
+            out.append(obj)
+        return out
+
     def update(self, kind: str, obj) -> object:
         meta = obj.metadata
         data = self._request(

@@ -129,6 +129,27 @@ class Store:
                 out.append(obj.deepcopy())
             return out
 
+    def list_claimable(self, kind: str, namespace: str,
+                       selector: Dict[str, str],
+                       owner_uid: str) -> List[object]:
+        """Objects a controller's claim pass must see: label matches OR
+        already owned by ``owner_uid`` (covers owned objects whose
+        labels stopped matching, which release needs). Filters before
+        the deepcopy, so a namespace full of other jobs' pods costs
+        nothing (a full namespace list() would deepcopy every object
+        per job sync)."""
+        with self._lock:
+            out = []
+            for (ns, _), obj in self._objects.get(kind, {}).items():
+                if ns != namespace:
+                    continue
+                if not matches_selector(obj.metadata.labels, selector):
+                    ref = obj.metadata.controller_ref()
+                    if ref is None or ref.uid != owner_uid:
+                        continue
+                out.append(obj.deepcopy())
+            return out
+
     def update(self, kind: str, obj) -> object:
         """Full-object update with optimistic concurrency: the caller's
         resourceVersion must match the stored one."""
