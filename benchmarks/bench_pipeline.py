@@ -30,22 +30,16 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _setup(cpu_mesh: bool):
-    if cpu_mesh and ("--xla_force_host_platform_device_count"
-                     not in os.environ.get("XLA_FLAGS", "")):
-        # The backend may already be pinned (axon sitecustomize imports
-        # jax at startup), so env mutation here is too late — re-exec
-        # with the flags set from birth.
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8")
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.execv(sys.executable, [sys.executable] + sys.argv)
-    import jax
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from timing import setup as _setup  # noqa: E402
+from timing import timed as _timed_scalar  # noqa: E402
 
-    if cpu_mesh:
-        jax.config.update("jax_platforms", "cpu")
-    return jax
+
+def timed(fn, *args):
+    """Shared two-point timing, plus the final output for callers that
+    inspect it."""
+    t = _timed_scalar(fn, *args)
+    return t, fn(*args)
 
 
 def make_stage(hid, mlp, dtype):
@@ -67,34 +61,6 @@ def make_stage(hid, mlp, dtype):
         return per
 
     return stage_fn, init
-
-
-def _sync(out):
-    """Host-transfer sync: block_until_ready can return early on the
-    tunneled PJRT plugin (see bench_attention.py)."""
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    float(leaf.ravel()[0])
-
-
-def _block(fn, args, n):
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = fn(*args)
-    _sync(out)
-    return time.perf_counter() - t0
-
-
-def timed(fn, *args, warm=2):
-    """Two-point extrapolated per-call time: the tunnel charges a large
-    fixed sync cost C per timing block (measured ~90 ms), so t(n) =
-    t_call + C/n; solving from n=5 and n=25 removes C."""
-    for _ in range(warm):
-        out = fn(*args)
-    _sync(out)
-    n1, n2 = 5, 25
-    t1 = _block(fn, args, n1)
-    t2 = _block(fn, args, n2)
-    return max((t2 - t1) / (n2 - n1), 1e-9), out
 
 
 def run_cpu_mesh():

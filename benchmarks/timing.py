@@ -1,0 +1,72 @@
+"""Shared benchmark timing/setup harness.
+
+Two platform quirks every bench must handle (docs/benchmarks.md,
+"Timing methodology note"):
+
+- ``jax.block_until_ready`` can return early on the tunneled PJRT
+  plugin, so syncing is a host transfer (``float()``);
+- the tunnel charges a large fixed sync cost (~90 ms) per timing block,
+  so per-call time is extrapolated from two block sizes:
+  t(n) = t_call + C/n  =>  t_call = (n2·T2 − n1·T1)/(n2 − n1).
+
+``setup(cpu_mesh=True)`` re-execs the process with a CPU backend and 8
+virtual devices when the current XLA_FLAGS don't already pin that exact
+device count (the axon sitecustomize initializes the backend before
+user code runs, so mutating the env in-process is too late).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import time
+
+CPU_MESH_DEVICES = 8
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def setup(cpu_mesh: bool):
+    """Import-and-return jax, re-execing first when a CPU mesh of
+    CPU_MESH_DEVICES is requested but not active."""
+    if cpu_mesh:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+        if m is None or int(m.group(1)) != CPU_MESH_DEVICES:
+            flags = re.sub(rf"{_COUNT_FLAG}=\d+", "", flags).strip()
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} {_COUNT_FLAG}={CPU_MESH_DEVICES}".strip())
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+    import jax
+
+    if cpu_mesh:
+        jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def sync(out) -> None:
+    """Host-transfer sync (block_until_ready is unreliable here)."""
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(leaf.ravel()[0])
+
+
+def _block(fn, args, n: int) -> float:
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    sync(out)
+    return time.perf_counter() - t0
+
+
+def timed(fn, *args, warm: int = 2, n1: int = 5, n2: int = 25) -> float:
+    """Two-point extrapolated per-call seconds."""
+    out = None
+    for _ in range(warm):
+        out = fn(*args)
+    sync(out)
+    t1 = _block(fn, args, n1)
+    t2 = _block(fn, args, n2)
+    return max((t2 - t1) / (n2 - n1), 1e-9)

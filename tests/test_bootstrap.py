@@ -142,11 +142,16 @@ def test_render_env_golden():
         "test-cluster-spec-chief-0.default.svc:8476"
     assert env["JAX_NUM_PROCESSES"] == "3"
     assert env["JAX_PROCESS_ID"] == "2"
-    assert env["TPU_WORKER_ID"] == "2"
+    # TPU slice membership is worker-scoped (the chief is a coordinator
+    # process, not a TPU host): per-slice id, workers-only host list.
+    assert env["TPU_WORKER_ID"] == "1"
     assert env["TPU_WORKER_HOSTNAMES"] == (
-        "test-cluster-spec-chief-0.default.svc,"
         "test-cluster-spec-worker-0.default.svc,"
         "test-cluster-spec-worker-1.default.svc")
+    chief_env = render_worker_env(job, "chief", 0, domain="")
+    assert "TPU_WORKER_ID" not in chief_env
+    assert "TPU_WORKER_HOSTNAMES" not in chief_env
+    assert chief_env["JAX_PROCESS_ID"] == "0"
     cluster = json.loads(env["TPUJOB_CLUSTER_SPEC"])
     assert cluster["task"] == {"type": "worker", "index": 1}
     assert "MEGASCALE_NUM_SLICES" not in env
@@ -205,3 +210,73 @@ def test_ps_gets_cluster_spec_but_no_jax_rank():
     env = render_worker_env(job, "ps", 0, domain="")
     assert "TPUJOB_CLUSTER_SPEC" in env
     assert "JAX_PROCESS_ID" not in env
+
+
+def test_multislice_per_slice_worker_env():
+    # Round-2 hardening: TPU_WORKER_ID / TPU_WORKER_HOSTNAMES are scoped
+    # to the slice (libtpu semantics), while JAX_* stay global.
+    job = make_job(worker=8, accelerator="v5p-32")
+    job.spec.slice.num_slices = 2
+    env = render_worker_env(job, "worker", 5, domain="")
+    # v5p-32 = 4 hosts/slice; worker 5 = slice 1, in-slice id 1.
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    assert env["TPU_WORKER_ID"] == "1"
+    hosts = env["TPU_WORKER_HOSTNAMES"].split(",")
+    assert [h.split(".")[0] for h in hosts] == [
+        f"{job.metadata.name}-worker-{i}" for i in (4, 5, 6, 7)]
+    # Global jax.distributed view is unchanged.
+    assert env["JAX_PROCESS_ID"] == "5"
+    assert env["JAX_NUM_PROCESSES"] == "8"
+    # Slice coordinator = first worker of THIS slice.
+    assert env["MEGASCALE_SLICE_COORDINATOR"].startswith(
+        f"{job.metadata.name}-worker-4.")
+
+
+def test_multislice_chief_is_not_a_slice_host():
+    job = make_job(worker=8, chief=1, accelerator="v5p-32")
+    job.spec.slice.num_slices = 2
+    env = render_worker_env(job, "chief", 0, domain="")
+    # The chief coordinates jax.distributed globally...
+    assert env["JAX_PROCESS_ID"] == "0"
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    # ...but must not claim TPU slice membership.
+    assert "TPU_WORKER_ID" not in env
+    assert "TPU_WORKER_HOSTNAMES" not in env
+    assert "MEGASCALE_SLICE_ID" not in env
+    # Workers keep per-slice ids regardless of the chief's rank offset.
+    wenv = render_worker_env(job, "worker", 4, domain="")
+    assert (wenv["TPU_WORKER_ID"], wenv["MEGASCALE_SLICE_ID"]) == ("0", "1")
+    assert wenv["JAX_PROCESS_ID"] == "5"  # chief is global rank 0
+
+
+def test_single_slice_worker_scoped_tpu_env():
+    # num_slices == 1 with an accelerator: same worker-scoped slice
+    # semantics as multislice, just without the MEGASCALE_* layer.
+    job = make_job(worker=2, chief=1, accelerator="v5p-32")
+    env = render_worker_env(job, "worker", 1, domain="")
+    assert env["TPU_WORKER_ID"] == "1"
+    assert "chief" not in env["TPU_WORKER_HOSTNAMES"]
+    assert "MEGASCALE_NUM_SLICES" not in env
+
+
+def test_no_accelerator_keeps_legacy_global_worker_env():
+    # Plain process jobs (no TPU slice declared) keep rank-based ids and
+    # the full ranked host list — the local-runtime contract.
+    job = make_job(worker=2, chief=1)
+    env = render_worker_env(job, "worker", 1, domain="")
+    assert env["TPU_WORKER_ID"] == "2"
+    assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 3
+
+
+def test_validation_warnings_ps_and_multislice_shape():
+    from tf_operator_tpu.api.validation import validation_warnings
+
+    job = make_job(worker=6, ps=2, accelerator="v5p-32")
+    job.spec.slice.num_slices = 2  # wants 8 workers, spec has 6
+    warnings = validation_warnings(job)
+    assert any("parameter-server" in w for w in warnings)
+    assert any("under- or over-subscribed" in w for w in warnings)
+    # A well-shaped job warns about neither.
+    ok = make_job(worker=8, accelerator="v5p-32")
+    ok.spec.slice.num_slices = 2
+    assert validation_warnings(ok) == []

@@ -139,3 +139,44 @@ def _slice_errors(spec: TPUJobSpec):
                "'2x2x4'")
     if sl.num_slices < 1:
         yield "spec.slice.numSlices must be >= 1"
+
+
+def validation_warnings(job: TPUJob) -> List[str]:
+    """Non-fatal spec smells, surfaced as Warning events on the job
+    (the reference has no warning channel; closest analog is the event
+    stream its harness scans). Covers:
+
+    - ``ps`` replicas: API-surface parity only — this framework has no
+      parameter-server runtime (docs/parity.md §2.3), so ps-typed pods
+      run their command with no PS serving behind them;
+    - multislice shape mismatch: numSlices > 1 with a worker count that
+      is not hosts_per_slice x num_slices leaves slices under- or
+      over-subscribed.
+    """
+    warnings: List[str] = []
+    spec = job.spec
+    ps = spec.replica_specs.get(ReplicaType.PS)
+    if ps is not None and (ps.replicas or 0) > 0:
+        warnings.append(
+            "spec.replicaSpecs[ps]: the parameter-server strategy is "
+            "API-surface parity only — ps pods schedule and run their "
+            "command, but no PS runtime exists (use synchronous data "
+            "parallelism over ICI instead; docs/parity.md §2.3)")
+    sl = spec.slice
+    if sl.accelerator and sl.num_slices > 1:
+        from tf_operator_tpu.bootstrap.topology import parse_accelerator
+
+        try:
+            topo = parse_accelerator(sl.accelerator, sl.topology,
+                                     sl.num_slices)
+        except ValueError:
+            topo = None
+        worker = spec.replica_specs.get(ReplicaType.WORKER)
+        n_workers = (worker.replicas or 0) if worker else 0
+        if topo is not None and n_workers != topo.num_hosts:
+            warnings.append(
+                f"spec.slice: numSlices={sl.num_slices} x "
+                f"{topo.hosts_per_slice} hosts/slice wants "
+                f"{topo.num_hosts} workers, spec declares {n_workers} — "
+                "slices will be under- or over-subscribed")
+    return warnings

@@ -189,23 +189,53 @@ def render_worker_env(job: TPUJob, rtype: str, index: int,
         env["JAX_COORDINATOR_ADDRESS"] = coordinator_address(job, domain)
         env["JAX_NUM_PROCESSES"] = str(num_processes)
         env["JAX_PROCESS_ID"] = str(rank)
-        env["TPU_WORKER_ID"] = str(rank)
-        hostnames = []
-        for t in _RANKED_TYPES:
-            spec = job.spec.replica_specs.get(t)
-            for i in range(spec.replicas or 0) if spec else ():
-                hostnames.append(replica_dns_name(job, t, i, domain))
-        env["TPU_WORKER_HOSTNAMES"] = ",".join(hostnames)
 
-        if topo is not None and topo.num_slices > 1:
-            # Multislice (DCN) coordination, megascale-style. Slice hosts
-            # are the *workers*, assigned slice-major by worker index — a
-            # chief/master offsets the global rank but is not a slice host,
-            # so the slice id must come from the worker index, not the rank.
-            worker_pos = index if rt == ReplicaType.WORKER else 0
-            env["MEGASCALE_COORDINATOR_ADDRESS"] = env["JAX_COORDINATOR_ADDRESS"]
-            env["MEGASCALE_NUM_SLICES"] = str(topo.num_slices)
-            env["MEGASCALE_SLICE_ID"] = str(
-                worker_pos // max(1, topo.hosts_per_slice))
+        if topo is None:
+            # Plain process job (no TPU slice declared): legacy behavior,
+            # every ranked process is a "worker host".
+            env["TPU_WORKER_ID"] = str(rank)
+            hostnames = []
+            for t in _RANKED_TYPES:
+                spec = job.spec.replica_specs.get(t)
+                for i in range(spec.replicas or 0) if spec else ():
+                    hostnames.append(replica_dns_name(job, t, i, domain))
+            env["TPU_WORKER_HOSTNAMES"] = ",".join(hostnames)
+        elif rt == ReplicaType.WORKER:
+            # TPU slice hosts are the *workers*, assigned slice-major by
+            # worker index. Semantics (round-2 hardening, all slice
+            # counts):
+            #  - JAX_* stay GLOBAL: jax.distributed rendezvous spans all
+            #    processes (coordinator included) across slices;
+            #  - TPU_WORKER_ID / TPU_WORKER_HOSTNAMES are PER-SLICE:
+            #    libtpu scopes slice bring-up to the slice, so the id is
+            #    index % hosts_per_slice and the hostnames list only this
+            #    slice's workers (a chief/master offsets the global rank
+            #    but must never appear in the TPU host list);
+            #  - multislice additionally gets MEGASCALE_* incl. a
+            #    per-slice coordinator (the slice's first worker).
+            hps = max(1, topo.hosts_per_slice)
+            slice_id = index // hps
+            n_workers = (job.spec.replica_specs[rt].replicas or 0)
+            lo = slice_id * hps
+            hi = min(lo + hps, max(n_workers, index + 1))
+            slice_hosts = [replica_dns_name(job, rt, i, domain)
+                           for i in range(lo, hi)]
+            env["TPU_WORKER_ID"] = str(index % hps)
+            env["TPU_WORKER_HOSTNAMES"] = ",".join(slice_hosts)
+            if topo.num_slices > 1:
+                env["MEGASCALE_COORDINATOR_ADDRESS"] = \
+                    env["JAX_COORDINATOR_ADDRESS"]
+                env["MEGASCALE_NUM_SLICES"] = str(topo.num_slices)
+                env["MEGASCALE_SLICE_ID"] = str(slice_id)
+                env["MEGASCALE_SLICE_COORDINATOR"] = (
+                    f"{slice_hosts[0]}:{replica_port(job, rt)}")
+        else:
+            # chief/master/evaluator on a TPU job: a coordinator-only
+            # process, not a slice host — global JAX_* env, no TPU slice
+            # membership claims.
+            if topo.num_slices > 1:
+                env["MEGASCALE_COORDINATOR_ADDRESS"] = \
+                    env["JAX_COORDINATOR_ADDRESS"]
+                env["MEGASCALE_NUM_SLICES"] = str(topo.num_slices)
 
     return env

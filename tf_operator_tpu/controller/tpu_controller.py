@@ -21,7 +21,11 @@ from tf_operator_tpu.api.types import (
     TPUJob,
     JobConditionType,
 )
-from tf_operator_tpu.api.validation import ValidationError, validate_job
+from tf_operator_tpu.api.validation import (
+    ValidationError,
+    validate_job,
+    validation_warnings,
+)
 from tf_operator_tpu.bootstrap import render_worker_env
 from tf_operator_tpu.controller import conditions as cond
 from tf_operator_tpu.controller import status as status_mod
@@ -322,6 +326,11 @@ class TPUJobController(JobPlugin):
             msg = f"TPUJob {key} is created."
             cond.update_job_conditions(job.status, JobConditionType.CREATED,
                                        cond.JOB_CREATED_REASON, msg)
+            # Non-fatal spec smells surface once, as Warning events on
+            # the fresh job (ps-without-runtime, multislice shape).
+            for warning in validation_warnings(job):
+                self.recorder.event(job, EVENT_TYPE_WARNING,
+                                    "ValidationWarning", warning)
 
         needs_sync = (job.spec.enable_elastic_worker
                       or self.satisfied_expectations(job))

@@ -34,6 +34,7 @@ ENV_KEYS = (
     "MEGASCALE_COORDINATOR_ADDRESS",
     "MEGASCALE_NUM_SLICES",
     "MEGASCALE_SLICE_ID",
+    "MEGASCALE_SLICE_COORDINATOR",
     "TPUJOB_POD_NAME",
     "TPUJOB_POD_NAMESPACE",
 )
