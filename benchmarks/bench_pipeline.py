@@ -25,21 +25,12 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from timing import setup as _setup  # noqa: E402
-from timing import timed as _timed_scalar  # noqa: E402
-
-
-def timed(fn, *args):
-    """Shared two-point timing, plus the final output for callers that
-    inspect it."""
-    t = _timed_scalar(fn, *args)
-    return t, fn(*args)
+from timing import setup as _setup, timed  # noqa: E402
 
 
 def make_stage(hid, mlp, dtype):
@@ -104,8 +95,8 @@ def run_cpu_mesh():
 
                 return jax.value_and_grad(loss)(p)
 
-            t_1f1b, _ = timed(train_1f1b, stacked, x, tgt)
-            t_gpipe, _ = timed(train_gpipe, stacked, x, tgt)
+            t_1f1b = timed(train_1f1b, stacked, x, tgt)
+            t_gpipe = timed(train_gpipe, stacked, x, tgt)
             lowered = train_1f1b.lower(stacked, x, tgt).compile()
             lowered_g = train_gpipe.lower(stacked, x, tgt).compile()
 
@@ -169,7 +160,7 @@ def run_chip_overhead():
 
         return jax.value_and_grad(loss)(p)
 
-    t_plain, _ = timed(plain, stacked, x, tgt)
+    t_plain = timed(plain, stacked, x, tgt)
     print(json.dumps({"pp": 1, "mode": "plain_fused",
                       "t_ms": round(t_plain * 1e3, 3)}), flush=True)
 
@@ -180,7 +171,7 @@ def run_chip_overhead():
             return pipeline_train_sharded(stage_fn, loss_fn, p, x, t,
                                           mesh, num_microbatches=m)
 
-        t_1f1b, _ = timed(train, stacked, x, tgt)
+        t_1f1b = timed(train, stacked, x, tgt)
         ms.append(m)
         ts.append(t_1f1b)
         print(json.dumps({

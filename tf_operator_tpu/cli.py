@@ -61,6 +61,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gate pods behind all-or-nothing SliceGroup admission")
     p.add_argument("--total-chips", type=int, default=None,
                    help="chip capacity for gang admission (None = unlimited)")
+    p.add_argument("--gang-fairness", default="aged",
+                   choices=("backfill", "strict", "aged"),
+                   help="admission policy when the FIFO head doesn't "
+                        "fit: backfill past it, strict head-of-line, or "
+                        "aged (backfill until --gang-aging-seconds, then "
+                        "hold capacity for the starved group)")
+    p.add_argument("--gang-aging-seconds", type=float, default=300.0,
+                   help="wait before an unadmitted group blocks backfill "
+                        "(only with --gang-fairness aged)")
     p.add_argument("--monitoring-port", type=int, default=8443,
                    help="port for /metrics, /healthz "
                         "(0 = disabled, -1 = ephemeral)")
@@ -127,7 +136,9 @@ class Server:
                 client,
                 namespace=args.namespace or None,
                 enable_gang_scheduling=args.enable_gang_scheduling,
-                total_chips=args.total_chips)
+                total_chips=args.total_chips,
+                gang_fairness=args.gang_fairness,
+                gang_aging_seconds=args.gang_aging_seconds)
             self.store = self.operator.store
             self._lease_store = KubeLeaseStore(client)
         else:
@@ -140,6 +151,8 @@ class Server:
                 namespace=args.namespace or None,
                 enable_gang_scheduling=args.enable_gang_scheduling,
                 total_chips=args.total_chips,
+                gang_fairness=args.gang_fairness,
+                gang_aging_seconds=args.gang_aging_seconds,
                 **op_kwargs)
         self.api_server = None
         if getattr(args, "api_port", 0) != 0:

@@ -52,12 +52,34 @@ def _chips_for(group: SliceGroup) -> int:
 
 class SliceGangScheduler(GangScheduler):
     """FIFO whole-slice admission. ``total_chips=None`` = unlimited capacity
-    (admission always succeeds, groups still tracked for observability)."""
+    (admission always succeeds, groups still tracked for observability).
 
-    def __init__(self, store: Store, total_chips: Optional[int] = None):
+    ``fairness`` decides what happens when the FIFO head doesn't fit
+    (Volcano-style queue policy; reference Volcano does priority/queue
+    backfill):
+
+    - ``"backfill"``: skip it, keep admitting later smaller groups —
+      maximum utilization, but a large job can starve behind a stream of
+      small ones;
+    - ``"strict"``: head-of-line — nothing behind a non-fitting group
+      admits until it fits (no starvation, idles capacity);
+    - ``"aged"`` (default): backfill until a skipped group has waited
+      ``aging_seconds``; from then on it blocks all later admissions, so
+      freed capacity accumulates for it and a large job is guaranteed to
+      eventually admit under small-job churn.
+    """
+
+    def __init__(self, store: Store, total_chips: Optional[int] = None,
+                 fairness: str = "aged", aging_seconds: float = 300.0):
+        if fairness not in ("backfill", "strict", "aged"):
+            raise ValueError(f"unknown gang fairness {fairness!r}")
         self.store = store
         self.total_chips = total_chips
+        self.fairness = fairness
+        self.aging_seconds = aging_seconds
         self._lock = threading.Lock()
+        # group key -> monotonic time it was first seen unadmittable.
+        self._waiting_since: Dict[tuple, float] = {}
 
     # -- engine hooks ---------------------------------------------------
 
@@ -119,21 +141,57 @@ class SliceGangScheduler(GangScheduler):
 
     def _admit(self) -> None:
         """FIFO all-or-nothing: walk groups by creation order; admit while
-        the whole slice request fits the remaining chip budget."""
+        the whole slice request fits the remaining chip budget, applying
+        the configured fairness when a group doesn't fit."""
+        import time as _time
+
         with self._lock:
             groups = sorted(self.store.list(store_mod.SLICEGROUPS),
                             key=lambda g: (g.metadata.creation_timestamp
                                            or 0, g.metadata.name))
+            # Collected up-front: a fairness break below must not make
+            # queued-behind groups look vanished (that would reset their
+            # aging clocks every pass).
+            live_keys = {(g.metadata.namespace, g.metadata.name)
+                         for g in groups}
             used = sum(_chips_for(g) for g in groups
                        if g.status.phase in (PHASE_INQUEUE, PHASE_RUNNING))
             for group in groups:
+                key = (group.metadata.namespace, group.metadata.name)
                 if group.status.phase in (PHASE_INQUEUE, PHASE_RUNNING):
                     continue
                 need = _chips_for(group)
-                if self.total_chips is not None and used + need > self.total_chips:
-                    continue  # stays Pending; later groups may still fit
+                if self.total_chips is not None and need > self.total_chips:
+                    # Infeasible on this cluster at ANY occupancy: can
+                    # never be satisfied, so it must not block the queue
+                    # (it stays Pending; the capacity-vs-request mismatch
+                    # is the operator's to fix, not later jobs' to wait
+                    # out).
+                    log.warning("slice group %s needs %d chips but the "
+                                "cluster has %d; skipping (infeasible)",
+                                group.metadata.name, need, self.total_chips)
+                    continue
+                if (self.total_chips is not None
+                        and used + need > self.total_chips):
+                    waited = self._waiting_since.setdefault(
+                        key, _time.monotonic())
+                    if self.fairness == "strict":
+                        break  # head-of-line: nothing behind it admits
+                    if (self.fairness == "aged"
+                            and _time.monotonic() - waited
+                            >= self.aging_seconds):
+                        log.info("slice group %s aged out backfill; "
+                                 "holding capacity for it",
+                                 group.metadata.name)
+                        break
+                    continue  # backfill: later groups may still fit
                 used += need
+                self._waiting_since.pop(key, None)
                 group.status.phase = PHASE_INQUEUE
                 self.store.update_status(store_mod.SLICEGROUPS, group)
                 log.info("admitted slice group %s (%d chips)",
                          group.metadata.name, need)
+            # Drop wait records for groups that no longer exist.
+            for key in list(self._waiting_since):
+                if key not in live_keys:
+                    del self._waiting_since[key]

@@ -39,14 +39,18 @@ class Operator:
                  config: Optional[EngineConfig] = None,
                  namespace: Optional[str] = None,
                  enable_gang_scheduling: bool = False,
-                 total_chips: Optional[int] = None):
+                 total_chips: Optional[int] = None,
+                 gang_fairness: str = "aged",
+                 gang_aging_seconds: float = 300.0):
         self.store = store or Store()
         self.recorder = Recorder(sink=self._persist_event)
         config = config or EngineConfig()
         gang = None
         if enable_gang_scheduling:
             config.enable_gang_scheduling = True
-            gang = SliceGangScheduler(self.store, total_chips=total_chips)
+            gang = SliceGangScheduler(self.store, total_chips=total_chips,
+                                      fairness=gang_fairness,
+                                      aging_seconds=gang_aging_seconds)
         self.controller = TPUJobController(self.store, recorder=self.recorder,
                                            config=config, gang=gang,
                                            namespace=namespace)

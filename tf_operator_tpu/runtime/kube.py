@@ -806,6 +806,8 @@ class KubeOperator:
                  namespace: Optional[str] = None,
                  enable_gang_scheduling: bool = False,
                  total_chips: Optional[int] = None,
+                 gang_fairness: str = "aged",
+                 gang_aging_seconds: float = 300.0,
                  config: Optional[EngineConfig] = None,
                  post_events: bool = True):
         self.client = client
@@ -816,7 +818,9 @@ class KubeOperator:
         gang = None
         if enable_gang_scheduling:
             config.enable_gang_scheduling = True
-            gang = SliceGangScheduler(self.store, total_chips=total_chips)
+            gang = SliceGangScheduler(self.store, total_chips=total_chips,
+                                      fairness=gang_fairness,
+                                      aging_seconds=gang_aging_seconds)
         self.controller = KubeJobController(client, store=self.store,
                                             recorder=recorder, config=config,
                                             gang=gang, namespace=namespace)
