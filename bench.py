@@ -87,8 +87,28 @@ def bench_resnet50(batch_size: int, image_size: int, steps: int,
     rates = sorted(batch_size * steps / dt for dt in times)
     median = rates[len(rates) // 2]
     spread = (rates[-1] - rates[0]) / median if median else 0.0
+
+    # Companion stat: the tunnel charges a fixed host-sync cost per
+    # timed block (~90 ms measured; docs/benchmarks.md "Timing
+    # methodology note"), so a single block's rate understates steady-
+    # state training throughput. Extrapolate t(n) = t_step + C/n from
+    # the median block and one 3x-longer block. The primary value stays
+    # the round-1-comparable median; this reports what the chip
+    # actually sustains.
+    t_med = sorted(times)[len(times) // 2]
+    t0 = time.perf_counter()
+    for _ in range(3 * steps):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+    t_long = time.perf_counter() - t0
+    per_step = (t_long - t_med) / (2 * steps)
+    # Degenerate extrapolation (timer hiccup): report null, not a number
+    # that masquerades as "sync cost exactly zero".
+    corrected = batch_size / per_step if per_step > 0 else None
     return median, {"best": rates[-1], "worst": rates[0],
-                    "spread_frac": round(spread, 4), "reps": len(rates)}
+                    "spread_frac": round(spread, 4), "reps": len(rates),
+                    "sync_corrected": (round(corrected, 2)
+                                       if corrected else None)}
 
 
 def main() -> int:

@@ -715,8 +715,9 @@ def test_gang_aged_fairness_admits_large_job_under_churn(tmp_path):
                                           "default", "big") is not None,
                  message="big slice group")
         time.sleep(0.7)  # > aging window: big is now head-of-line
-        assert all(p.status.phase == "Pending"
-                   for p in client.get_pods("big"))
+        pods_big = client.get_pods("big")
+        assert pods_big and all(p.status.phase == "Pending"
+                                for p in pods_big)
         # Churn: more small jobs arrive — they must NOT be admitted past
         # the aged big job even as capacity frees.
         client.create(stub_job("small-2", stub_dir, worker=1,
@@ -786,7 +787,8 @@ def test_gang_infeasible_group_does_not_block_queue(tmp_path):
                                args=("--exit-after", "0.3")))
         job = client.wait_for_job("fits", timeout=15)
         assert testutil.check_condition(job, JobConditionType.SUCCEEDED)
-        assert all(p.status.phase == "Pending"
-                   for p in client.get_pods("toobig"))
+        pods_toobig = client.get_pods("toobig")
+        assert pods_toobig and all(p.status.phase == "Pending"
+                                   for p in pods_toobig)
     finally:
         op.stop()

@@ -78,8 +78,8 @@ class SliceGangScheduler(GangScheduler):
         self.fairness = fairness
         self.aging_seconds = aging_seconds
         self._lock = threading.Lock()
-        # group key -> monotonic time it was first seen unadmittable.
-        self._waiting_since: Dict[tuple, float] = {}
+        # Groups already flagged infeasible (log once, not per pass).
+        self._warned_infeasible: set = set()
 
     # -- engine hooks ---------------------------------------------------
 
@@ -142,16 +142,18 @@ class SliceGangScheduler(GangScheduler):
     def _admit(self) -> None:
         """FIFO all-or-nothing: walk groups by creation order; admit while
         the whole slice request fits the remaining chip budget, applying
-        the configured fairness when a group doesn't fit."""
-        import time as _time
+        the configured fairness when a group doesn't fit.
 
+        Aging is anchored on the group's persisted creationTimestamp, so
+        the no-starvation guarantee survives operator restarts and
+        leader failovers (an in-memory clock would reset to zero)."""
+        import datetime as _dt
+
+        now = _dt.datetime.now(_dt.timezone.utc)
         with self._lock:
             groups = sorted(self.store.list(store_mod.SLICEGROUPS),
                             key=lambda g: (g.metadata.creation_timestamp
                                            or 0, g.metadata.name))
-            # Collected up-front: a fairness break below must not make
-            # queued-behind groups look vanished (that would reset their
-            # aging clocks every pass).
             live_keys = {(g.metadata.namespace, g.metadata.name)
                          for g in groups}
             used = sum(_chips_for(g) for g in groups
@@ -166,32 +168,31 @@ class SliceGangScheduler(GangScheduler):
                     # never be satisfied, so it must not block the queue
                     # (it stays Pending; the capacity-vs-request mismatch
                     # is the operator's to fix, not later jobs' to wait
-                    # out).
-                    log.warning("slice group %s needs %d chips but the "
-                                "cluster has %d; skipping (infeasible)",
-                                group.metadata.name, need, self.total_chips)
+                    # out). Flag once, not on every admission pass.
+                    if key not in self._warned_infeasible:
+                        self._warned_infeasible.add(key)
+                        log.warning("slice group %s needs %d chips but "
+                                    "the cluster has %d; skipping "
+                                    "(infeasible)", group.metadata.name,
+                                    need, self.total_chips)
                     continue
                 if (self.total_chips is not None
                         and used + need > self.total_chips):
-                    waited = self._waiting_since.setdefault(
-                        key, _time.monotonic())
+                    created = group.metadata.creation_timestamp
+                    waited = ((now - created).total_seconds()
+                              if created is not None else 0.0)
                     if self.fairness == "strict":
                         break  # head-of-line: nothing behind it admits
                     if (self.fairness == "aged"
-                            and _time.monotonic() - waited
-                            >= self.aging_seconds):
+                            and waited >= self.aging_seconds):
                         log.info("slice group %s aged out backfill; "
                                  "holding capacity for it",
                                  group.metadata.name)
                         break
                     continue  # backfill: later groups may still fit
                 used += need
-                self._waiting_since.pop(key, None)
                 group.status.phase = PHASE_INQUEUE
                 self.store.update_status(store_mod.SLICEGROUPS, group)
                 log.info("admitted slice group %s (%d chips)",
                          group.metadata.name, need)
-            # Drop wait records for groups that no longer exist.
-            for key in list(self._waiting_since):
-                if key not in live_keys:
-                    del self._waiting_since[key]
+            self._warned_infeasible &= live_keys
