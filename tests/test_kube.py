@@ -388,3 +388,99 @@ class TestKubeLeaderElection:
         a.stop()  # releases -> b takes over
         assert b.wait_until_leading(timeout=5)
         b.stop()
+
+
+class TestKubeSdk:
+    """TPUJobClient directly against the (fake) cluster: the reference
+    SDK deployment shape (kubernetes-client from kubeconfig)."""
+
+    @pytest.fixture()
+    def sdk(self, client):
+        from tf_operator_tpu.runtime.kube import KubeSdkStore
+        from tf_operator_tpu.sdk import TPUJobClient
+
+        return TPUJobClient(KubeSdkStore(client), namespace="default")
+
+    @pytest.fixture()
+    def operator_with_events(self, client):
+        op = KubeOperator(client, post_events=True)
+        op.start(threadiness=1, sync_timeout=10)
+        yield op
+        op.stop()
+
+    def test_full_lifecycle_surface(self, sdk, fake, operator_with_events):
+        job = sdk.create(make_job(name="sdkjob", workers=2))
+        assert job.metadata.uid
+
+        # Watch: replay + live condition events through the K8s stream.
+        events = []
+        for etype, j in sdk.watch(name="sdkjob", timeout=20,
+                                  until_finished=True):
+            events.append((etype, [c.type for c in j.status.conditions]))
+            phases = {p["status"]["phase"] for p in
+                      fake.state.list("pods", "default", "")["items"]}
+            if phases == {"Pending"}:
+                fake.state.set_all_pods_phase("default", "Running")
+            elif phases == {"Running"}:
+                fake.state.set_all_pods_phase("default", "Succeeded")
+        assert any("Succeeded" in conds for _, conds in events)
+        assert sdk.is_job_succeeded("sdkjob")
+
+        # Pod surface.
+        assert sdk.get_pod_names("sdkjob") == ["sdkjob-worker-0",
+                                               "sdkjob-worker-1"]
+        assert sdk.get_pod_names("sdkjob", replica_index=1) == [
+            "sdkjob-worker-1"]
+
+        # Logs through the kubelet log API (fake log store).
+        fake.state.set_pod_log("default", "sdkjob-worker-0",
+                               "line1\nline2\nline3")
+        assert sdk.get_logs("sdkjob-worker-0").endswith("line3")
+        assert sdk.get_logs("sdkjob-worker-0", tail_lines=1) == "line3"
+
+        # Events posted by the operator as core/v1 Events, recovered
+        # through the job-name attribution.
+        evs = sdk.get_events("sdkjob")
+        assert any(e.reason == "SuccessfulCreatePod" for e in evs)
+        assert sdk.get_creation_failures("sdkjob") == []
+
+        # Delete + wait_for_delete.
+        sdk.delete("sdkjob")
+        sdk.wait_for_delete("sdkjob", timeout=10)
+
+    def test_patch_read_modify_write_cas(self, sdk, fake, operator):
+        sdk.create(make_job(name="patchjob", workers=1))
+
+        def bump(job):
+            job.spec.run_policy.backoff_limit = 7
+
+        updated = sdk.patch("patchjob", bump)
+        assert updated.spec.run_policy.backoff_limit == 7
+        raw = fake.state.get(constants.PLURAL, "default", "patchjob")
+        assert raw["spec"]["runPolicy"]["backoffLimit"] == 7
+
+    def test_stream_logs_follow(self, sdk, fake, operator):
+        sdk.create(make_job(name="streamjob", workers=1))
+        wait_for(lambda: fake.state.list("pods", "default", "")["items"],
+                 msg="pod created")
+        fake.state.set_pod_phase("default", "streamjob-worker-0", "Running")
+        fake.state.set_pod_log("default", "streamjob-worker-0", "early\n")
+
+        chunks = []
+        import threading
+
+        def consume():
+            for chunk in sdk.stream_logs("streamjob-worker-0"):
+                chunks.append(chunk)
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        fake.state.append_pod_log("default", "streamjob-worker-0", "late\n")
+        time.sleep(0.3)
+        fake.state.set_pod_phase("default", "streamjob-worker-0",
+                                 "Succeeded")
+        t.join(timeout=10)
+        assert not t.is_alive(), "follow stream never terminated"
+        text = "".join(chunks)
+        assert "early" in text and "late" in text

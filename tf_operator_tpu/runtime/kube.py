@@ -267,7 +267,11 @@ class KubeClient:
     def _path(self, kind: str, ns: Optional[str], name: str = "") -> str:
         if kind == store_mod.TPUJOBS:
             return self._crd(ns, name)
-        resource = "services" if kind == store_mod.ENDPOINTS else "pods"
+        resource = {store_mod.PODS: "pods",
+                    store_mod.ENDPOINTS: "services",
+                    store_mod.EVENTS: "events"}.get(kind)
+        if resource is None:
+            raise KeyError(f"no K8s resource mapping for kind {kind!r}")
         return self._core(resource, ns, name)
 
     # -- typed verbs -------------------------------------------------------
@@ -282,9 +286,11 @@ class KubeClient:
         return self.request("DELETE", self._path(kind, ns, name))
 
     def list(self, kind: str, ns: Optional[str] = None,
-             selector: Optional[Dict[str, str]] = None) -> dict:
+             selector: Optional[Dict[str, str]] = None,
+             field_selector: str = "") -> dict:
         return self.request("GET", self._path(kind, ns),
-                            params={"labelSelector": _selector_str(selector)})
+                            params={"labelSelector": _selector_str(selector),
+                                    "fieldSelector": field_selector})
 
     def patch(self, kind: str, ns: str, name: str, patch: dict,
               subresource: str = "") -> dict:
@@ -954,3 +960,232 @@ class KubeLeaseStore:
                 "spec": self._spec_to_k8s(lease)}
         return self._from_k8s(
             self.client.request("PUT", self._path(ns, name), body=body))
+
+
+# ---------------------------------------------------------------------------
+# SDK-facing store adapter: TPUJobClient directly against a K8s cluster
+# ---------------------------------------------------------------------------
+
+class _KubeWatcher:
+    """Store.Watcher analog over a K8s watch stream."""
+
+    def __init__(self, client: KubeClient, kind: str,
+                 handler: Callable[[str, object], None],
+                 namespace: Optional[str], replay: bool,
+                 from_k8s: Callable[[dict], object],
+                 on_stop: Optional[Callable[["_KubeWatcher"], None]] = None):
+        self.client = client
+        self.kind = kind
+        self.handler = handler
+        self.namespace = namespace
+        self.replay = replay
+        self._from_k8s = from_k8s
+        self._on_stop = on_stop
+        self._stop = threading.Event()
+        self._resp_box: list = []
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"kube-watch-{kind}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        first = True
+        while not self._stop.is_set():
+            try:
+                listing = self.client.list(self.kind, self.namespace)
+                # First relist replays as ADDED (informer initial list);
+                # RECONNECT relists re-deliver as MODIFIED so state that
+                # changed in the disconnect gap (e.g. a job finishing
+                # during a 410/timeout window) is never lost — the same
+                # level-triggered recovery KubeInformer's upsert does.
+                if self.replay or not first:
+                    etype = store_mod.ADDED if first else store_mod.MODIFIED
+                    for raw in listing.get("items") or []:
+                        self.handler(etype, self._from_k8s(raw))
+                first = False
+                rv = str((listing.get("metadata") or {})
+                         .get("resourceVersion", "") or "0")
+                for etype, raw in self.client.watch(
+                        self.kind, self.namespace, None, rv,
+                        resp_box=self._resp_box):
+                    if self._stop.is_set():
+                        return
+                    if etype in ("BOOKMARK", "ERROR"):
+                        if etype == "ERROR":
+                            break  # relist
+                        continue
+                    self.handler(etype, self._from_k8s(raw))
+            except Exception:
+                if self._stop.is_set():
+                    return
+                log.debug("kube watch %s reconnecting after error",
+                          self.kind, exc_info=True)
+                self._stop.wait(1.0)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for resp in self._resp_box:
+            try:
+                resp.close()
+            except OSError:
+                pass
+        self._thread.join(timeout=5)
+        if self._on_stop is not None:
+            self._on_stop(self)
+
+
+def _event_from_k8s(d: dict) -> "object":
+    from tf_operator_tpu.api.types import EventRecord
+
+    involved = d.get("involvedObject") or {}
+    record = EventRecord(
+        metadata=_meta_from_k8s(d.get("metadata") or {}),
+        involved_kind=involved.get("kind", ""),
+        involved_name=involved.get("name", ""),
+        type=d.get("type", ""),
+        reason=d.get("reason", ""),
+        message=d.get("message", ""))
+    # The in-process recorder stamps a job-name label; K8s Events carry
+    # the target in involvedObject instead — reconstruct the label so
+    # label-selector consumers (get_events) work unchanged.
+    if record.involved_kind == constants.KIND:
+        record.metadata.labels.setdefault(constants.LABEL_JOB_NAME,
+                                          record.involved_name)
+    return record
+
+
+class KubeSdkStore:
+    """Duck-types the Store surface ``TPUJobClient`` uses, directly
+    against a Kubernetes cluster — the reference SDK's deployment shape
+    (kubernetes-client from kubeconfig, tf_job_client.py:55-100):
+    TPUJob CRs, pods, Events, watches, and the pod-log API."""
+
+    def __init__(self, client: KubeClient):
+        self.client = client
+        self._watchers: list = []
+
+    @staticmethod
+    def _to_k8s(kind: str, obj) -> dict:
+        if kind == store_mod.TPUJOBS:
+            return tpujob_to_k8s(obj)
+        if kind == store_mod.PODS:
+            return pod_to_k8s(obj)
+        if kind == store_mod.ENDPOINTS:
+            return service_to_k8s(obj)
+        raise KeyError(f"unsupported kind {kind!r}")
+
+    @staticmethod
+    def _from_k8s(kind: str, raw: dict):
+        if kind == store_mod.EVENTS:
+            return _event_from_k8s(raw)
+        return FROM_K8S[kind](raw)
+
+    # -- CRUD -----------------------------------------------------------
+
+    def create(self, kind: str, obj):
+        ns = obj.metadata.namespace or "default"
+        return self._from_k8s(kind, self.client.create(
+            kind, ns, self._to_k8s(kind, obj)))
+
+    def get(self, kind: str, namespace: str, name: str):
+        return self._from_k8s(kind, self.client.get(kind, namespace, name))
+
+    def try_get(self, kind: str, namespace: str, name: str):
+        try:
+            return self.get(kind, namespace, name)
+        except store_mod.NotFoundError:
+            return None
+
+    def update(self, kind: str, obj):
+        """Full replace under the object's resourceVersion (optimistic
+        concurrency — the cluster returns 409 on a stale rv, surfaced
+        as ConflictError for the SDK's read-modify-write retry)."""
+        ns, name = obj.metadata.namespace, obj.metadata.name
+        body = self._to_k8s(kind, obj)
+        body.setdefault("metadata", {})["resourceVersion"] = \
+            str(obj.metadata.resource_version or "")
+        raw = self.client.request("PUT", self.client._path(kind, ns, name),
+                                  body=body)
+        return self._from_k8s(kind, raw)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self.client.delete(kind, namespace, name)
+
+    def try_delete(self, kind: str, namespace: str, name: str) -> bool:
+        try:
+            self.client.delete(kind, namespace, name)
+            return True
+        except store_mod.NotFoundError:
+            return False
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             selector: Optional[Dict[str, str]] = None):
+        if kind == store_mod.EVENTS:
+            # K8s Events carry no useful labels. A job-name selector maps
+            # onto the server-side involvedObject fieldSelector (a busy
+            # shared namespace holds thousands of foreign events);
+            # remaining label constraints filter on the reconstructed
+            # labels client-side.
+            field_selector = ""
+            if selector and selector.get(constants.LABEL_JOB_NAME):
+                field_selector = ("involvedObject.name="
+                                  f"{selector[constants.LABEL_JOB_NAME]}")
+            items = [self._from_k8s(kind, raw) for raw in
+                     self.client.list(kind, namespace,
+                                      field_selector=field_selector)
+                     .get("items") or []]
+            if selector:
+                items = [e for e in items if store_mod.matches_selector(
+                    e.metadata.labels, selector)]
+            return items
+        return [self._from_k8s(kind, raw) for raw in
+                self.client.list(kind, namespace,
+                                 selector).get("items") or []]
+
+    # -- watch ----------------------------------------------------------
+
+    def watch(self, kind: str, handler, replay: bool = True):
+        w = _KubeWatcher(self.client, kind, handler, None, replay,
+                         from_k8s=lambda raw: self._from_k8s(kind, raw),
+                         on_stop=self._remove_watcher)
+        self._watchers.append(w)
+        return w
+
+    def _remove_watcher(self, w) -> None:
+        try:
+            self._watchers.remove(w)
+        except ValueError:
+            pass  # already removed (stop_watchers or double stop)
+
+    def stop_watchers(self) -> None:
+        watchers, self._watchers = self._watchers, []
+        for w in watchers:
+            w.stop()
+
+    # -- pod logs (kubelet log API) --------------------------------------
+
+    def read_logs(self, namespace: str, pod_name: str,
+                  tail_lines: Optional[int] = None) -> str:
+        params = {}
+        if tail_lines is not None:
+            params["tailLines"] = str(tail_lines)
+        resp = self.client.request(
+            "GET", f"/api/v1/namespaces/{namespace}/pods/{pod_name}/log",
+            params=params, stream=True)
+        with resp:
+            text = resp.read().decode("utf-8", "replace")
+        if tail_lines == 0:
+            return ""
+        return text
+
+    def stream_logs(self, namespace: str, pod_name: str):
+        resp = self.client.request(
+            "GET", f"/api/v1/namespaces/{namespace}/pods/{pod_name}/log",
+            params={"follow": "true"}, timeout=None, stream=True)
+        try:
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    return
+                yield chunk.decode("utf-8", "replace")
+        finally:
+            resp.close()

@@ -94,6 +94,8 @@ class FakeKubeState:
         self._rv = 0
         # (resource, queue) watch subscriptions
         self._watchers: List[Tuple[str, "_q.Queue"]] = []
+        # (ns, pod) -> log text, the fake kubelet's log store.
+        self.pod_logs: Dict[Tuple[str, str], str] = {}
 
     def next_rv(self) -> str:
         self._rv += 1
@@ -193,7 +195,7 @@ class FakeKubeState:
             return json.loads(json.dumps(obj))
 
     def list(self, resource: str, ns: Optional[str],
-             selector: str) -> dict:
+             selector: str, field_selector: str = "") -> dict:
         with self.lock:
             items = []
             for (ons, _), obj in self.objects[resource].items():
@@ -202,10 +204,29 @@ class FakeKubeState:
                 labels = (obj.get("metadata") or {}).get("labels") or {}
                 if not _match_selector(labels, selector):
                     continue
+                if field_selector and not self._match_fields(obj,
+                                                             field_selector):
+                    continue
                 items.append(json.loads(json.dumps(obj)))
             return {"kind": "List", "apiVersion": "v1",
                     "metadata": {"resourceVersion": str(self._rv)},
                     "items": items}
+
+    @staticmethod
+    def _match_fields(obj: dict, raw: str) -> bool:
+        """The fieldSelector subset real clients use on Events:
+        dotted-path equality (e.g. involvedObject.name=job)."""
+        for part in raw.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            path, _, want = part.partition("=")
+            node = obj
+            for seg in path.split("."):
+                node = node.get(seg, {}) if isinstance(node, dict) else {}
+            if (node if isinstance(node, str) else "") != want:
+                return False
+        return True
 
     # -- watch -------------------------------------------------------------
 
@@ -226,6 +247,16 @@ class FakeKubeState:
                 q.put((etype, payload))
 
     # -- fake kubelet ------------------------------------------------------
+
+    def set_pod_log(self, ns: str, name: str, text: str) -> None:
+        """Fake kubelet log store (served by GET .../pods/{name}/log)."""
+        with self.lock:
+            self.pod_logs[(ns, name)] = text
+
+    def append_pod_log(self, ns: str, name: str, text: str) -> None:
+        with self.lock:
+            self.pod_logs[(ns, name)] = self.pod_logs.get((ns, name),
+                                                          "") + text
 
     def set_pod_phase(self, ns: str, name: str, phase: str,
                       exit_code: Optional[int] = None,
@@ -345,11 +376,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         def run():
-            resource, ns, name, _, query = self._route()
+            resource, ns, name, sub, query = self._route()
             if resource == "_crd_probe":
                 return self._send_json(200, {
                     "kind": "CustomResourceDefinition",
                     "metadata": {"name": constants.CRD_NAME}})
+            if resource == "pods" and name and sub == "log":
+                return self._serve_pod_log(ns or "default", name, query)
             if name:
                 return self._send_json(200,
                                        self.state.get(resource, ns or
@@ -357,7 +390,8 @@ class _Handler(BaseHTTPRequestHandler):
             if query.get("watch") in ("1", "true"):
                 return self._serve_watch(resource, ns, query)
             return self._send_json(200, self.state.list(
-                resource, ns, query.get("labelSelector", "")))
+                resource, ns, query.get("labelSelector", ""),
+                field_selector=query.get("fieldSelector", "")))
         self._guard(run)
 
     def do_POST(self):
@@ -400,6 +434,54 @@ class _Handler(BaseHTTPRequestHandler):
                                                   name, self._read_body(),
                                                   subresource=sub))
         self._guard(run)
+
+    # -- pod logs (kubelet log API subresource) ----------------------------
+
+    def _serve_pod_log(self, ns: str, name: str, query) -> None:
+        import time as _time
+
+        self.state.get("pods", ns, name)  # 404 when the pod is gone
+        follow = query.get("follow") in ("1", "true")
+        if not follow:
+            text = self.state.pod_logs.get((ns, name), "")
+            tail = query.get("tailLines")
+            if tail is not None:
+                n = int(tail)
+                lines = text.splitlines()[-n:] if n > 0 else []
+                text = "\n".join(lines)
+            body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        # follow: stream appended text until the pod reaches a terminal
+        # phase (kubectl logs -f semantics).
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        pos = 0
+        try:
+            while True:
+                text = self.state.pod_logs.get((ns, name), "")
+                if len(text) > pos:
+                    chunk = text[pos:].encode()
+                    pos = len(text)
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+                    continue
+                try:
+                    pod = self.state.get("pods", ns, name)
+                except _HttpError:
+                    return
+                phase = (pod.get("status") or {}).get("phase", "")
+                if phase in ("Succeeded", "Failed"):
+                    return
+                _time.sleep(0.05)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
 
     # -- watch -------------------------------------------------------------
 

@@ -41,6 +41,26 @@ class TPUJobClient:
 
         return cls(RemoteStore(server_url), namespace=namespace)
 
+    @classmethod
+    def connect_kube(cls, kubeconfig: Optional[str] = None,
+                     namespace: Optional[str] = None) -> "TPUJobClient":
+        """Client directly against a Kubernetes cluster running the
+        operator with ``--backend=kube`` — the reference SDK's shape
+        (kubernetes-client from kubeconfig, tf_job_client.py:55-100):
+
+            client = TPUJobClient.connect_kube()          # ~/.kube/config
+            client = TPUJobClient.connect_kube("/path/to/kubeconfig")
+        """
+        from tf_operator_tpu.runtime.kube import (
+            KubeClient,
+            KubeConfig,
+            KubeSdkStore,
+        )
+
+        config = KubeConfig.resolve(kubeconfig)
+        return cls(KubeSdkStore(KubeClient(config)),
+                   namespace=namespace or config.namespace or "default")
+
     # -- CRUD (reference tf_job_client.py:77-222) -----------------------
 
     def create(self, job: Union[TPUJob, dict],
