@@ -583,29 +583,29 @@ class KubeEndpointControl(EndpointControl):
 # Informer: cluster state -> Store cache
 # ---------------------------------------------------------------------------
 
-class KubeInformer:
-    """List+watch one kind into the Store (reflector analog). The Store's
-    watch fan-out then drives the controller handlers exactly as the
-    local runtime does."""
+class _Reflector:
+    """Shared list+watch+reconnect loop (client-go reflector analog):
+    relist, stream the watch, relist again on expiry/error, abortable
+    mid-read. Subclasses supply ``_on_list(first, items)`` and
+    ``_on_event(etype, raw)`` sinks."""
 
-    def __init__(self, client: KubeClient, store: Store, kind: str,
+    def __init__(self, client: KubeClient, kind: str,
                  namespace: Optional[str] = None,
-                 selector: Optional[Dict[str, str]] = None):
+                 selector: Optional[Dict[str, str]] = None,
+                 thread_name: str = ""):
         self.client = client
-        self.store = store
         self.kind = kind
         self.namespace = namespace
         self.selector = selector
-        self._from_k8s = FROM_K8S[kind]
+        self._thread_name = thread_name or f"reflector-{kind}"
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._resp_box: list = []
-        self.synced = threading.Event()
+        self._failures = 0
 
-    def start(self) -> "KubeInformer":
+    def start(self):
         self._thread = threading.Thread(target=self._run,
-                                        name=f"informer-{self.kind}",
-                                        daemon=True)
+                                        name=self._thread_name, daemon=True)
         self._thread.start()
         return self
 
@@ -622,22 +622,64 @@ class KubeInformer:
             self._thread.join(timeout=5)
 
     def _run(self) -> None:
+        first = True
         while not self._stop.is_set():
             try:
-                rv = self._relist()
-                self.synced.set()
-                self._watch(rv)
+                listing = self.client.list(self.kind, self.namespace,
+                                           self.selector)
+                self._on_list(first, listing.get("items") or [])
+                first = False
+                self._failures = 0
+                rv = str((listing.get("metadata") or {})
+                         .get("resourceVersion", "") or "0")
+                for etype, raw in self.client.watch(
+                        self.kind, self.namespace, self.selector, rv,
+                        resp_box=self._resp_box):
+                    if self._stop.is_set():
+                        return
+                    if etype == "BOOKMARK":
+                        continue
+                    if etype == "ERROR":
+                        raise KubeApiError(410, "Expired",
+                                           "watch expired; relist")
+                    self._on_event(etype, raw)
             except Exception:
                 if self._stop.is_set():
                     return
-                log.debug("informer %s relisting after error", self.kind,
-                          exc_info=True)
+                self._failures += 1
+                # A transient blip logs at debug; a PERSISTENT failure
+                # (403 from missing RBAC, bad server, expired token)
+                # must not hide there — it would look like a silent hang.
+                logfn = (log.warning if self._failures == 3
+                         or self._failures % 300 == 0 else log.debug)
+                logfn("reflector %s retrying after %d consecutive "
+                      "errors", self.kind, self._failures, exc_info=True)
                 self._stop.wait(1.0)
 
-    def _relist(self) -> str:
-        listing = self.client.list(self.kind, self.namespace, self.selector)
+    def _on_list(self, first: bool, items) -> None:
+        raise NotImplementedError
+
+    def _on_event(self, etype: str, raw: dict) -> None:
+        raise NotImplementedError
+
+
+class KubeInformer(_Reflector):
+    """List+watch one kind into the Store (reflector analog). The Store's
+    watch fan-out then drives the controller handlers exactly as the
+    local runtime does."""
+
+    def __init__(self, client: KubeClient, store: Store, kind: str,
+                 namespace: Optional[str] = None,
+                 selector: Optional[Dict[str, str]] = None):
+        super().__init__(client, kind, namespace, selector,
+                         thread_name=f"informer-{kind}")
+        self.store = store
+        self._from_k8s = FROM_K8S[kind]
+        self.synced = threading.Event()
+
+    def _on_list(self, first: bool, items) -> None:
         seen = set()
-        for raw in listing.get("items") or []:
+        for raw in items:
             obj = self._from_k8s(raw)
             seen.add((obj.metadata.namespace, obj.metadata.name))
             self._upsert(obj)
@@ -645,25 +687,15 @@ class KubeInformer:
         for ns, name, _ in self.store.keys(self.kind):
             if (ns, name) not in seen:
                 self.store.try_delete(self.kind, ns, name)
-        return str((listing.get("metadata") or {}).get("resourceVersion", "")
-                   or "0")
+        self.synced.set()
 
-    def _watch(self, rv: str) -> None:
-        for etype, raw in self.client.watch(self.kind, self.namespace,
-                                            self.selector, rv,
-                                            resp_box=self._resp_box):
-            if self._stop.is_set():
-                return
-            if etype == "BOOKMARK":
-                continue
-            if etype == "ERROR":
-                raise KubeApiError(410, "Expired", "watch expired; relist")
-            obj = self._from_k8s(raw)
-            if etype == "DELETED":
-                self.store.try_delete(self.kind, obj.metadata.namespace,
-                                      obj.metadata.name)
-            else:
-                self._upsert(obj)
+    def _on_event(self, etype: str, raw: dict) -> None:
+        obj = self._from_k8s(raw)
+        if etype == store_mod.DELETED:
+            self.store.try_delete(self.kind, obj.metadata.namespace,
+                                  obj.metadata.name)
+        else:
+            self._upsert(obj)
 
     def _upsert(self, obj) -> None:
         cur = self.store.try_get(self.kind, obj.metadata.namespace,
@@ -966,71 +998,60 @@ class KubeLeaseStore:
 # SDK-facing store adapter: TPUJobClient directly against a K8s cluster
 # ---------------------------------------------------------------------------
 
-class _KubeWatcher:
-    """Store.Watcher analog over a K8s watch stream."""
+class _KubeWatcher(_Reflector):
+    """Store.Watcher analog over a K8s watch stream: delivers translated
+    (event_type, obj) pairs to a handler, surviving stream expiry."""
 
     def __init__(self, client: KubeClient, kind: str,
                  handler: Callable[[str, object], None],
                  namespace: Optional[str], replay: bool,
                  from_k8s: Callable[[dict], object],
                  on_stop: Optional[Callable[["_KubeWatcher"], None]] = None):
-        self.client = client
-        self.kind = kind
+        super().__init__(client, kind, namespace,
+                         thread_name=f"kube-watch-{kind}")
         self.handler = handler
-        self.namespace = namespace
         self.replay = replay
         self._from_k8s = from_k8s
-        self._on_stop = on_stop
-        self._stop = threading.Event()
-        self._resp_box: list = []
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name=f"kube-watch-{kind}")
-        self._thread.start()
+        self._notify_stop = on_stop
+        # (ns, name) -> last delivered object, for synthesizing DELETED
+        # after a disconnect gap.
+        self._known: Dict[Tuple[str, str], object] = {}
+        self.start()
 
-    def _run(self) -> None:
-        first = True
-        while not self._stop.is_set():
-            try:
-                listing = self.client.list(self.kind, self.namespace)
-                # First relist replays as ADDED (informer initial list);
-                # RECONNECT relists re-deliver as MODIFIED so state that
-                # changed in the disconnect gap (e.g. a job finishing
-                # during a 410/timeout window) is never lost — the same
-                # level-triggered recovery KubeInformer's upsert does.
-                if self.replay or not first:
-                    etype = store_mod.ADDED if first else store_mod.MODIFIED
-                    for raw in listing.get("items") or []:
-                        self.handler(etype, self._from_k8s(raw))
-                first = False
-                rv = str((listing.get("metadata") or {})
-                         .get("resourceVersion", "") or "0")
-                for etype, raw in self.client.watch(
-                        self.kind, self.namespace, None, rv,
-                        resp_box=self._resp_box):
-                    if self._stop.is_set():
-                        return
-                    if etype in ("BOOKMARK", "ERROR"):
-                        if etype == "ERROR":
-                            break  # relist
-                        continue
-                    self.handler(etype, self._from_k8s(raw))
-            except Exception:
-                if self._stop.is_set():
-                    return
-                log.debug("kube watch %s reconnecting after error",
-                          self.kind, exc_info=True)
-                self._stop.wait(1.0)
+    def _on_list(self, first: bool, items) -> None:
+        seen: Dict[Tuple[str, str], object] = {}
+        for raw in items:
+            obj = self._from_k8s(raw)
+            seen[(obj.metadata.namespace, obj.metadata.name)] = obj
+        # First relist replays as ADDED (informer initial list);
+        # RECONNECT relists re-deliver as MODIFIED so state that changed
+        # in the disconnect gap (e.g. a job finishing during a
+        # 410/timeout window) is never lost, and objects that VANISHED
+        # in the gap get a synthesized DELETED (a watch(until_finished)
+        # consumer would otherwise block forever on a deleted job).
+        if self.replay or not first:
+            etype = store_mod.ADDED if first else store_mod.MODIFIED
+            for obj in seen.values():
+                self.handler(etype, obj)
+        if not first:
+            for key, obj in self._known.items():
+                if key not in seen:
+                    self.handler(store_mod.DELETED, obj)
+        self._known = seen
+
+    def _on_event(self, etype: str, raw: dict) -> None:
+        obj = self._from_k8s(raw)
+        key = (obj.metadata.namespace, obj.metadata.name)
+        if etype == store_mod.DELETED:
+            self._known.pop(key, None)
+        else:
+            self._known[key] = obj
+        self.handler(etype, obj)
 
     def stop(self) -> None:
-        self._stop.set()
-        for resp in self._resp_box:
-            try:
-                resp.close()
-            except OSError:
-                pass
-        self._thread.join(timeout=5)
-        if self._on_stop is not None:
-            self._on_stop(self)
+        super().stop()
+        if self._notify_stop is not None:
+            self._notify_stop(self)
 
 
 def _event_from_k8s(d: dict) -> "object":
@@ -1059,8 +1080,13 @@ class KubeSdkStore:
     (kubernetes-client from kubeconfig, tf_job_client.py:55-100):
     TPUJob CRs, pods, Events, watches, and the pod-log API."""
 
-    def __init__(self, client: KubeClient):
+    def __init__(self, client: KubeClient,
+                 namespace: Optional[str] = None):
         self.client = client
+        # Watches scope to this namespace when set: a namespaced Role
+        # (the common non-admin kubeconfig) cannot list cluster-wide,
+        # and the SDK filters to one namespace anyway.
+        self.namespace = namespace
         self._watchers: list = []
 
     @staticmethod
@@ -1144,7 +1170,8 @@ class KubeSdkStore:
     # -- watch ----------------------------------------------------------
 
     def watch(self, kind: str, handler, replay: bool = True):
-        w = _KubeWatcher(self.client, kind, handler, None, replay,
+        w = _KubeWatcher(self.client, kind, handler, self.namespace,
+                         replay,
                          from_k8s=lambda raw: self._from_k8s(kind, raw),
                          on_stop=self._remove_watcher)
         self._watchers.append(w)
@@ -1168,9 +1195,13 @@ class KubeSdkStore:
         params = {}
         if tail_lines is not None:
             params["tailLines"] = str(tail_lines)
-        resp = self.client.request(
-            "GET", f"/api/v1/namespaces/{namespace}/pods/{pod_name}/log",
-            params=params, stream=True)
+        try:
+            resp = self.client.request(
+                "GET",
+                f"/api/v1/namespaces/{namespace}/pods/{pod_name}/log",
+                params=params, stream=True)
+        except store_mod.NotFoundError:
+            return ""  # transport parity: a vanished pod has no logs
         with resp:
             text = resp.read().decode("utf-8", "replace")
         if tail_lines == 0:
@@ -1178,9 +1209,13 @@ class KubeSdkStore:
         return text
 
     def stream_logs(self, namespace: str, pod_name: str):
-        resp = self.client.request(
-            "GET", f"/api/v1/namespaces/{namespace}/pods/{pod_name}/log",
-            params={"follow": "true"}, timeout=None, stream=True)
+        try:
+            resp = self.client.request(
+                "GET",
+                f"/api/v1/namespaces/{namespace}/pods/{pod_name}/log",
+                params={"follow": "true"}, timeout=None, stream=True)
+        except store_mod.NotFoundError:
+            return  # transport parity: empty stream for a vanished pod
         try:
             while True:
                 chunk = resp.read1(65536)

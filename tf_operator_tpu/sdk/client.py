@@ -58,8 +58,9 @@ class TPUJobClient:
         )
 
         config = KubeConfig.resolve(kubeconfig)
-        return cls(KubeSdkStore(KubeClient(config)),
-                   namespace=namespace or config.namespace or "default")
+        ns = namespace or config.namespace or "default"
+        return cls(KubeSdkStore(KubeClient(config), namespace=ns),
+                   namespace=ns)
 
     # -- CRUD (reference tf_job_client.py:77-222) -----------------------
 
