@@ -16,6 +16,8 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import timing  # noqa: E402
 
 
 def main() -> int:
@@ -80,21 +82,19 @@ def main() -> int:
         for _ in range(3):
             state, m = step(state, {"inputs": tok})
         float(m["loss"])
+
         # Two-block de-drifted timing (docs/benchmarks.md methodology
-        # note): the tunnel charges ~90 ms fixed sync per block, so
-        # subtract a 1x block from a 3x block.
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            state, m = step(state, {"inputs": tok})
-        float(m["loss"])
-        t1 = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        for _ in range(3 * args.steps):
-            state, m = step(state, {"inputs": tok})
-        float(m["loss"])
-        t3 = time.perf_counter() - t0
-        dt = max((t3 - t1) / (2 * args.steps), 1e-9)
-        dt_single = t1 / args.steps
+        # note): the tunnel charges ~90 ms fixed sync per block.
+        def run_block(n, state_box=[state]):
+            t0 = time.perf_counter()
+            st = state_box[0]
+            for _ in range(n):
+                st, m = step(st, {"inputs": tok})
+            float(m["loss"])
+            state_box[0] = st
+            return time.perf_counter() - t0
+
+        dt, dt_single = timing.timed_two_block(run_block, args.steps)
 
     nparams = sum(x.size for x in jax.tree.leaves(state.params))
     attn_fl = 3.5 * 4 * cfg.n_layers * cfg.n_heads * S * S \

@@ -70,3 +70,13 @@ def timed(fn, *args, warm: int = 2, n1: int = 5, n2: int = 25) -> float:
     t1 = _block(fn, args, n1)
     t2 = _block(fn, args, n2)
     return max((t2 - t1) / (n2 - n1), 1e-9)
+
+
+def timed_two_block(run_block, steps: int):
+    """De-drift for STATEFUL step loops (training benches): the caller's
+    ``run_block(n)`` executes n steps with a trailing host sync and
+    returns elapsed seconds. Returns (per_step_seconds,
+    single_block_per_step) from a 1x and a 3x block."""
+    t1 = run_block(steps)
+    t3 = run_block(3 * steps)
+    return max((t3 - t1) / (2 * steps), 1e-9), t1 / steps

@@ -26,6 +26,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DEFAULT_SUITES = [
     "tests/test_e2e_local.py",
+    "tests/test_e2e_remote.py",
+    "tests/test_kube.py",
+    "tests/test_claim_races.py",
     "tests/test_engine.py",
     "tests/test_bootstrap.py",
 ]

@@ -46,8 +46,10 @@ class LlamaConfig:
     remat: bool = True
     # "" = auto (pallas flash on TPU when shapes tile, else XLA);
     # "flash" = force the pallas kernel; "xla" = force the reference;
-    # "ring" = ring attention over sp (call must be inside shard_map;
-    # the trainer arranges this when sp > 1).
+    # "ring" = einsum ring attention over sp; "ring_flash" = ring with
+    # the pallas flash kernel per block (preferred when block shapes
+    # tile; both ring modes run inside shard_map, which the trainer
+    # arranges when sp > 1).
     attention_impl: str = ""
     sp_axis: str = "sp"
 
@@ -85,24 +87,41 @@ class LlamaAttention(nn.Module):
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
         if cfg.attention_impl in ("ring", "xla"):
-            # These paths need full-head KV; the flash kernel reads the
-            # shared GQA head directly (no repeated copy in HBM).
+            # These paths need full-head KV; the flash kernels (incl.
+            # ring_flash) read the shared GQA head directly (no repeated
+            # copy in HBM).
             k = repeat_kv(k, cfg.n_heads // cfg.n_kv_heads)
             v = repeat_kv(v, cfg.n_heads // cfg.n_kv_heads)
 
-        if cfg.attention_impl == "ring":
+        if cfg.attention_impl in ("ring", "ring_flash"):
             from tf_operator_tpu.parallel.mesh import active_mesh, data_axes
             from jax.sharding import PartitionSpec as P
             import functools
+
+            from tf_operator_tpu.ops.ring_attention import (
+                ring_flash_attention,
+            )
 
             mesh = active_mesh()
             if mesh is None:
                 raise ValueError("ring attention requires an active mesh "
                                  "(wrap the step in parallel.mesh.use_mesh)")
+            tp_size = mesh.shape.get("tp", 1)
+            if (cfg.attention_impl == "ring_flash"
+                    and k.shape[2] % max(tp_size, 1)):
+                # The head spec shards KV heads over tp; when tp does
+                # not divide the GQA head count, fall back to full-head
+                # KV (the kernel's native-GQA saving doesn't apply, but
+                # the sharding is well-formed).
+                k = repeat_kv(k, cfg.n_heads // cfg.n_kv_heads)
+                v = repeat_kv(v, cfg.n_heads // cfg.n_kv_heads)
             spec = P(data_axes(mesh), cfg.sp_axis,
                      "tp" if "tp" in mesh.axis_names else None, None)
+            inner = (ring_flash_attention
+                     if cfg.attention_impl == "ring_flash"
+                     else ring_attention)
             out = jax.shard_map(
-                functools.partial(ring_attention, axis_name=cfg.sp_axis,
+                functools.partial(inner, axis_name=cfg.sp_axis,
                                   causal=True),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                 check_vma=False)(q, k, v)

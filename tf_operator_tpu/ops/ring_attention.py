@@ -93,13 +93,207 @@ def ring_attention_sharded(mesh: Mesh, q: jax.Array, k: jax.Array,
                            v: jax.Array, causal: bool = True,
                            axis_name: str = "sp",
                            batch_axes=("dcn", "dp", "fsdp"),
-                           head_axis: Optional[str] = "tp") -> jax.Array:
+                           head_axis: Optional[str] = "tp",
+                           impl: str = "auto") -> jax.Array:
     """Convenience wrapper: global [B, S, H, D] arrays -> ring attention
-    with S sharded over ``axis_name`` (and B/H over the data/tp axes)."""
+    with S sharded over ``axis_name`` (and B/H over the data/tp axes).
+
+    ``impl``: "flash" runs the pallas kernel per ring block (measured
+    3-5x faster than the einsum ring single-chip), "einsum" is the
+    original blockwise-softmax ring, "auto" picks flash when the
+    per-device block shape supports it.
+    """
+    from tf_operator_tpu.ops import flash_attention as fa
+
     batch = tuple(a for a in batch_axes if a in mesh.axis_names)
     spec = P(batch, axis_name, head_axis, None)
+    if impl == "auto":
+        sp = mesh.shape.get(axis_name, 1)
+        s_blk, d = q.shape[1] // max(sp, 1), q.shape[3]
+        bq, bk = fa._fit_block(s_blk, 512), fa._fit_block(s_blk, 1024)
+        impl = ("flash" if fa.flash_supported(s_blk, s_blk, d, bq, bk)
+                and q.shape[2] % k.shape[2] == 0 else "einsum")
+    if impl == "einsum" and k.shape[2] != q.shape[2]:
+        # The einsum ring needs full-head KV (the flash ring reads the
+        # shared GQA head directly); repeat rather than crash deep in
+        # shard_map with an einsum shape error.
+        from tf_operator_tpu.ops.layers import repeat_kv
+
+        group = q.shape[2] // k.shape[2]
+        k = repeat_kv(k, group)
+        v = repeat_kv(v, group)
+    inner = (functools.partial(ring_flash_attention, axis_name=axis_name,
+                               causal=causal) if impl == "flash"
+             else functools.partial(ring_attention, axis_name=axis_name,
+                                    causal=causal))
     fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Ring FLASH attention: the pallas flash kernel per ring block
+# ---------------------------------------------------------------------------
+#
+# The einsum ring above materializes an [S_blk, S_blk] score tile per
+# step (measured 3-5x slower than flash single-chip). This variant runs
+# the flash kernel on every (q_block, kv_block) pair and merges the
+# normalized per-block outputs with their logsumexp statistics — the
+# full ring-flash algorithm:
+#
+# - step 0 computes the diagonal block with in-block causal masking;
+# - steps 1..n-1 rotate K/V one hop and run the kernel NON-causally
+#   (identical static kernel parameters on every rank keeps SPMD
+#   lock-step); visibility of an off-diagonal block under causality is
+#   a whole-block predicate (src < my), applied as a traced mask on the
+#   block's (out, lse) — masked blocks merge with weight exp(-1e30)=0;
+# - backward re-runs the ring with the per-pair flash backward
+#   (_bwd_impl) against the FINAL lse/delta; dK/dV accumulators rotate
+#   WITH their K/V blocks and take one final hop home.
+
+_NEG_INF = -1e30
+
+
+def _merge_block(acc_o, acc_lse, o, lse, visible):
+    """Fold one normalized block result into the running (out, lse)."""
+    lse = jnp.where(visible, lse, _NEG_INF)
+    o = jnp.where(visible, o.astype(jnp.float32), 0.0)
+    m = jnp.maximum(acc_lse, lse)
+    m_safe = jnp.maximum(m, _NEG_INF / 2)   # both masked: keep exp sane
+    w_acc = jnp.exp(acc_lse - m_safe)
+    w_new = jnp.exp(lse - m_safe)
+    denom = jnp.maximum(w_acc + w_new, 1e-30)
+    out = (acc_o * w_acc[..., None] + o * w_new[..., None]) \
+        / denom[..., None]
+    return out, m_safe + jnp.log(denom)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, causal, block_q, block_k, interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q,
+                                  block_k, interpret)
+    return out
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q, block_k,
+                         interpret):
+    from tf_operator_tpu.ops import flash_attention as fa
+
+    qh = q.transpose(0, 2, 1, 3)   # [B,H,S,D]
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    o0, lse0 = fa._fwd(qh, kh, vh, causal, 0, block_q, block_k, interpret)
+    acc_o = o0.astype(jnp.float32)
+    acc_lse = lse0[..., 0]
+
+    def step(carry, _):
+        k_blk, v_blk, src, acc_o, acc_lse = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src = jax.lax.ppermute(src, axis_name, perm)
+        o, lse = fa._fwd(qh, k_blk, v_blk, False, 0, block_q, block_k,
+                         interpret)
+        visible = (src < my) if causal else jnp.bool_(True)
+        acc_o, acc_lse = _merge_block(acc_o, acc_lse, o, lse[..., 0],
+                                      visible)
+        return (k_blk, v_blk, src, acc_o, acc_lse), None
+
+    carry = (kh, vh, my, acc_o, acc_lse)
+    if n > 1:
+        carry, _ = jax.lax.scan(step, carry, None, length=n - 1)
+    _, _, _, acc_o, acc_lse = carry
+    out_h = acc_o.astype(q.dtype)            # [B,H,S,D]
+    return out_h.transpose(0, 2, 1, 3), (qh, kh, vh, out_h, acc_lse)
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, block_q, block_k,
+                    interpret):
+    return _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q,
+                                block_k, interpret)
+
+
+def _ring_flash_bwd(axis_name, causal, block_q, block_k, interpret, res,
+                    do):
+    from tf_operator_tpu.ops import flash_attention as fa
+
+    qh, kh, vh, out_h, lse = res
+    do_h = do.transpose(0, 2, 1, 3)
+    lse_p = jnp.broadcast_to(lse[..., None], lse.shape + (fa._SUBS,))
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    dq0, dk0, dv0 = fa._bwd_impl(qh, kh, vh, out_h, lse_p, do_h, causal,
+                                 0, block_q, block_k, interpret)
+    dq_acc = dq0.astype(jnp.float32)
+
+    def step(carry, _):
+        k_blk, v_blk, dk_blk, dv_blk, src, dq_acc = carry
+        # dK/dV accumulators ride the ring with their blocks.
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        dk_blk = jax.lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = jax.lax.ppermute(dv_blk, axis_name, perm)
+        src = jax.lax.ppermute(src, axis_name, perm)
+        dq_c, dk_c, dv_c = fa._bwd_impl(qh, k_blk, v_blk, out_h, lse_p,
+                                        do_h, False, 0, block_q, block_k,
+                                        interpret)
+        visible = (src < my) if causal else jnp.bool_(True)
+        zero = jnp.zeros((), jnp.float32)
+        dq_acc = dq_acc + jnp.where(visible, dq_c.astype(jnp.float32),
+                                    zero)
+        dk_blk = dk_blk + jnp.where(visible, dk_c.astype(jnp.float32),
+                                    zero)
+        dv_blk = dv_blk + jnp.where(visible, dv_c.astype(jnp.float32),
+                                    zero)
+        return (k_blk, v_blk, dk_blk, dv_blk, src, dq_acc), None
+
+    carry = (kh, vh, dk0.astype(jnp.float32), dv0.astype(jnp.float32),
+             my, dq_acc)
+    if n > 1:
+        carry, _ = jax.lax.scan(step, carry, None, length=n - 1)
+    _, _, dk_rot, dv_rot, _, dq_acc = carry
+    # n-1 hops leave each block's accumulator one hop from home.
+    if n > 1:
+        dk_rot = jax.lax.ppermute(dk_rot, axis_name, perm)
+        dv_rot = jax.lax.ppermute(dv_rot, axis_name, perm)
+
+    dq = dq_acc.astype(qh.dtype).transpose(0, 2, 1, 3)
+    dk = dk_rot.astype(kh.dtype).transpose(0, 2, 1, 3)
+    dv = dv_rot.astype(vh.dtype).transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str = "sp", causal: bool = True,
+                         block_q: int = 512, block_k: int = 1024,
+                         interpret: Optional[bool] = None) -> jax.Array:
+    """Ring attention with the pallas flash kernel per block; call
+    inside shard_map. Same contract as ``ring_attention`` ([B, S_blk,
+    H, D] per-device blocks) plus native GQA (k/v may carry fewer
+    heads). Requires flash-supported block shapes."""
+    from tf_operator_tpu.ops import flash_attention as fa
+
+    s_blk, d = q.shape[1], q.shape[3]
+    bq = fa._fit_block(s_blk, block_q)
+    bk = fa._fit_block(s_blk, block_k)
+    if not fa.flash_supported(s_blk, s_blk, d, bq, bk):
+        raise ValueError(
+            f"ring_flash_attention unsupported for block shape "
+            f"{q.shape}; use the einsum ring (ring_attention, or "
+            "ring_attention_sharded(impl='einsum'))")
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"GQA head counts must divide: q heads {q.shape[2]}, "
+            f"kv heads {k.shape[2]}")
+    if interpret is None:
+        interpret = not fa.on_tpu()
+    return _ring_flash(q, k, v, axis_name, causal, bq, bk, interpret)

@@ -640,8 +640,10 @@ class _Reflector:
                     if etype == "BOOKMARK":
                         continue
                     if etype == "ERROR":
-                        raise KubeApiError(410, "Expired",
-                                           "watch expired; relist")
+                        # Routine watch expiry (410 Gone): relist
+                        # immediately — it is not a failure and must not
+                        # pay the error backoff or trip the warning.
+                        break
                     self._on_event(etype, raw)
             except Exception:
                 if self._stop.is_set():
