@@ -66,16 +66,8 @@ def main() -> int:
         float(m["loss"])
 
         # Two-block de-drifted timing (docs/benchmarks.md methodology).
-        def run_block(n, state_box=[state]):
-            t0 = time.perf_counter()
-            st = state_box[0]
-            for _ in range(n):
-                st, m = step(st, batch)
-            float(m["loss"])
-            state_box[0] = st
-            return time.perf_counter() - t0
-
-        dt, dt_single = timing.timed_two_block(run_block, args.steps)
+        dt, dt_single, state = timing.timed_two_block_stateful(
+            step, state, batch, args.steps)
 
     nparams = sum(x.size for x in jax.tree.leaves(state.params))
     print(json.dumps({

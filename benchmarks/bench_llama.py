@@ -85,16 +85,8 @@ def main() -> int:
 
         # Two-block de-drifted timing (docs/benchmarks.md methodology
         # note): the tunnel charges ~90 ms fixed sync per block.
-        def run_block(n, state_box=[state]):
-            t0 = time.perf_counter()
-            st = state_box[0]
-            for _ in range(n):
-                st, m = step(st, {"inputs": tok})
-            float(m["loss"])
-            state_box[0] = st
-            return time.perf_counter() - t0
-
-        dt, dt_single = timing.timed_two_block(run_block, args.steps)
+        dt, dt_single, state = timing.timed_two_block_stateful(
+            step, state, {"inputs": tok}, args.steps)
 
     nparams = sum(x.size for x in jax.tree.leaves(state.params))
     attn_fl = 3.5 * 4 * cfg.n_layers * cfg.n_heads * S * S \

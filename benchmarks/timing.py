@@ -80,3 +80,22 @@ def timed_two_block(run_block, steps: int):
     t1 = run_block(steps)
     t3 = run_block(3 * steps)
     return max((t3 - t1) / (2 * steps), 1e-9), t1 / steps
+
+
+def timed_two_block_stateful(step, state, batch, steps: int):
+    """timed_two_block for the common (state, metrics) = step(state,
+    batch) training-loop shape; syncs on metrics["loss"]. Returns
+    (per_step_seconds, single_block_per_step, final_state)."""
+    box = [state]
+
+    def run_block(n):
+        t0 = time.perf_counter()
+        st = box[0]
+        for _ in range(n):
+            st, m = step(st, batch)
+        float(m["loss"])
+        box[0] = st
+        return time.perf_counter() - t0
+
+    dt, dt_single = timed_two_block(run_block, steps)
+    return dt, dt_single, box[0]

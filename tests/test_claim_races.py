@@ -25,6 +25,7 @@ from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import PodPhase
 from tf_operator_tpu.operator import Operator
 from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.store import Store
 
 
 def wait_for(cond, timeout=10.0, interval=0.02, msg="condition"):
@@ -264,3 +265,45 @@ class TestClaimRaceInvariants:
             ctrl_refs = [r for r in p.metadata.owner_references
                          if r.controller]
             assert len(ctrl_refs) <= 1, p.metadata.name
+
+
+class DelayedStore(Store):
+    """Store whose watch deliveries LAG: every event waits a random
+    0-50 ms before delivery, but strictly in order (one drain thread) —
+    a real informer delays but never reorders a single watch stream.
+    This is the stale-cache regime expectations exist for."""
+
+    def __init__(self, seed: int):
+        super().__init__()
+        import queue
+        import threading
+
+        self._rng = random.Random(seed)
+        self._delay_q: "queue.Queue" = queue.Queue()
+        self._drain = threading.Thread(target=self._drain_loop, daemon=True)
+        self._drain.start()
+
+    def _notify(self, kind, event_type, obj):
+        self._delay_q.put((self._rng.uniform(0, 0.05), kind, event_type,
+                           obj))
+
+    def _drain_loop(self):
+        while True:
+            delay, kind, event_type, obj = self._delay_q.get()
+            time.sleep(delay)
+            Store._notify(self, kind, event_type, obj)
+
+
+class TestDelayedWatchRaces(TestClaimRaceInvariants):
+    """The same seeded interleavings, but with jittered watch delivery:
+    the controller's cache-view lags reality, so the expectation gate
+    (not event ordering) is what must prevent duplicate creates."""
+
+    @pytest.fixture()
+    def op(self):
+        from tf_operator_tpu.operator import Operator
+
+        operator = Operator(store=DelayedStore(seed=99), backend=None)
+        operator.start(threadiness=2)
+        yield operator
+        operator.stop()

@@ -484,3 +484,24 @@ class TestKubeSdk:
         assert not t.is_alive(), "follow stream never terminated"
         text = "".join(chunks)
         assert "early" in text and "late" in text
+
+
+class TestKubeScale:
+    def test_scale_up_and_down_via_cr_patch(self, client, fake, operator):
+        client.create(store_mod.TPUJOBS, "default", make_job(name="sc",
+                                                             workers=2))
+        wait_for(lambda: len(self._pods(fake)) == 2, msg="2 pods")
+        # Scale up 2 -> 3 via a spec merge patch on the CR.
+        client.patch(store_mod.TPUJOBS, "default", "sc",
+                     {"spec": {"replicaSpecs": {"worker": {"replicas": 3}}}})
+        wait_for(lambda: len(self._pods(fake)) == 3, msg="scaled to 3")
+        names = sorted(p["metadata"]["name"] for p in self._pods(fake))
+        assert names == [f"sc-worker-{i}" for i in range(3)]
+        # Scale down 3 -> 1: out-of-range indices deleted.
+        client.patch(store_mod.TPUJOBS, "default", "sc",
+                     {"spec": {"replicaSpecs": {"worker": {"replicas": 1}}}})
+        wait_for(lambda: len(self._pods(fake)) == 1, msg="scaled to 1")
+        assert self._pods(fake)[0]["metadata"]["name"] == "sc-worker-0"
+
+    def _pods(self, fake, ns="default"):
+        return fake.state.list("pods", ns, "")["items"]

@@ -345,6 +345,25 @@ class TestRingFlash:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+        # Backward with shared KV heads: the rotating dK/dV accumulators
+        # carry h_kv < h heads while each query-head group folds into
+        # its shared KV head.
+        def loss_ring(q, k, v):
+            o = ring_attention_sharded(mesh, q, k, v, causal=True,
+                                       head_axis=None, impl="flash")
+            return (o ** 2).mean()
+
+        def loss_ref(q, k, v):
+            o = attention(q, repeat_kv(k, 2), repeat_kv(v, 2), causal=True)
+            return (o ** 2).mean()
+
+        gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gr, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6,
+                                       err_msg=f"gqa d{name}")
+
     def test_auto_routing_picks_the_right_impl(self, monkeypatch):
         """impl="auto" must actually invoke the flash ring for supported
         blocks and the einsum ring (with KV repeated for GQA) otherwise."""

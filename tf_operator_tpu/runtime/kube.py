@@ -640,10 +640,18 @@ class _Reflector:
                     if etype == "BOOKMARK":
                         continue
                     if etype == "ERROR":
-                        # Routine watch expiry (410 Gone): relist
-                        # immediately — it is not a failure and must not
-                        # pay the error backoff or trip the warning.
-                        break
+                        code = int((raw or {}).get("code", 410) or 410)
+                        if code == 410:
+                            # Routine watch expiry (410 Gone): relist
+                            # immediately — not a failure, no backoff.
+                            break
+                        # Any other server-side watch error takes the
+                        # failure path (backoff + escalating log) —
+                        # otherwise a persistent error becomes a silent
+                        # hot list/watch loop.
+                        raise KubeApiError(code, (raw or {}).get(
+                            "reason", "WatchError"),
+                            (raw or {}).get("message", "watch error"))
                     self._on_event(etype, raw)
             except Exception:
                 if self._stop.is_set():
