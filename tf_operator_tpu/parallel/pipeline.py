@@ -39,6 +39,7 @@ How it maps to hardware:
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -165,7 +166,9 @@ LossFn = Callable[[jax.Array, jax.Array], jax.Array]
 def pipeline_train_1f1b(stage_fn: StageFn, loss_fn: LossFn,
                         stage_params: Any, microbatches: jax.Array,
                         targets: jax.Array, n_stages: int,
-                        axis_name: str = "pp"):
+                        axis_name: str = "pp",
+                        head_params: Any = None,
+                        return_input_grads: bool = False):
     """Fused forward/backward pipeline; call inside shard_map.
 
     Schedule (tick = one scan step; both slots run masked every tick):
@@ -178,6 +181,18 @@ def pipeline_train_1f1b(stage_fn: StageFn, loss_fn: LossFn,
 
     Returns (mean loss, grads for THIS rank's stage). Only the scalar
     loss is psum'd; gradients stay stage-sharded.
+
+    Full-model composition (an LM, not just a residual trunk):
+
+    - ``head_params``: extra differentiable params for the loss head
+      (e.g. the unembedding); ``loss_fn(y, targets, head_params)`` runs
+      on the LAST stage and their gradients come back psum-replicated.
+    - ``return_input_grads``: also return d(loss)/d(microbatches) —
+      valid on stage 0 (zeros elsewhere) — so the caller can close the
+      chain through its own embedding with ``jax.vjp``.
+
+    With either option the return is (loss, stage_grads, aux) where
+    aux = {"head_grads": ..., "input_grads": ...}.
     """
     pp = n_stages
     stage = lax.axis_index(axis_name)
@@ -186,18 +201,28 @@ def pipeline_train_1f1b(stage_fn: StageFn, loss_fn: LossFn,
     ticks = m + 2 * (pp - 1)
     fwd_ring = [(i, (i + 1) % pp) for i in range(pp)]
     bwd_ring = [(i, (i - 1) % pp) for i in range(pp)]
+    with_head = head_params is not None
+    hp0 = head_params if with_head else {}
 
     def mb_at(arr, j):
         return lax.dynamic_index_in_dim(arr, jnp.clip(j, 0, m - 1),
                                         axis=0, keepdims=False)
 
+    def head_loss(y, t_mb, hp):
+        return loss_fn(y, t_mb, hp) if with_head else loss_fn(y, t_mb)
+
     grads0 = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
+    hgrads0 = jax.tree_util.tree_map(jnp.zeros_like, hp0)
     ring0 = jnp.zeros((ring_depth,) + microbatches.shape[1:],
                       microbatches.dtype)
+    # Only materialized when requested: an O(m) fp32 carry would
+    # silently void the O(pp) activation-memory property otherwise.
+    dmb0 = (jnp.zeros(microbatches.shape, jnp.float32)
+            if return_input_grads else jnp.zeros((0,), jnp.float32))
     state0 = jnp.zeros_like(microbatches[0])
 
     def step(carry, t):
-        fwd_state, bwd_state, ring, grads, loss_sum = carry
+        fwd_state, bwd_state, ring, grads, hgrads, dmb, loss_sum = carry
 
         # -- forward slot: microbatch fj enters this stage ---------------
         fj = t - stage
@@ -219,8 +244,8 @@ def pipeline_train_1f1b(stage_fn: StageFn, loss_fn: LossFn,
                                          keepdims=False)
         y_re, vjp_fn = jax.vjp(stage_fn, stage_params, x_res)
         t_mb = mb_at(targets, bj)
-        loss_val, dy_last = jax.value_and_grad(
-            lambda yy: loss_fn(yy, t_mb))(y_re)
+        (loss_val, (dy_last, dhead)) = jax.value_and_grad(
+            head_loss, argnums=(0, 2))(y_re, t_mb, hp0)
         dy = jnp.where(stage == pp - 1, dy_last, bwd_state)
         dparams, dx = vjp_fn(dy)
         # Select, don't multiply-by-zero: bubble ticks run the backward
@@ -229,23 +254,41 @@ def pipeline_train_1f1b(stage_fn: StageFn, loss_fn: LossFn,
         grads = jax.tree_util.tree_map(
             lambda g, d: g + jnp.where(bwd_valid, d, jnp.zeros_like(d)),
             grads, dparams)
+        head_valid = jnp.logical_and(bwd_valid, stage == pp - 1)
+        hgrads = jax.tree_util.tree_map(
+            lambda g, d: g + jnp.where(head_valid, d, jnp.zeros_like(d)),
+            hgrads, dhead)
+        if return_input_grads:
+            # Stage 0's dx is d(loss)/d(microbatch bj): stash for the
+            # caller's embedding vjp.
+            dmb = lax.dynamic_update_index_in_dim(
+                dmb, jnp.where(jnp.logical_and(bwd_valid, stage == 0),
+                               dx.astype(jnp.float32), mb_at(dmb, bj)),
+                jnp.clip(bj, 0, m - 1), axis=0)
         loss_sum = loss_sum + jnp.where(
-            jnp.logical_and(bwd_valid, stage == pp - 1),
-            loss_val.astype(jnp.float32), 0.0)
+            head_valid, loss_val.astype(jnp.float32), 0.0)
 
         # -- ring handoffs (XLA overlaps with next tick's compute) -------
         fwd_state = lax.ppermute(y, axis_name, fwd_ring)
         bwd_state = lax.ppermute(dx, axis_name, bwd_ring)
-        return (fwd_state, bwd_state, ring, grads, loss_sum), None
+        return (fwd_state, bwd_state, ring, grads, hgrads, dmb,
+                loss_sum), None
 
-    carry0 = (state0, jnp.zeros_like(state0), ring0, grads0,
-              jnp.zeros((), jnp.float32))
-    (_, _, _, grads, loss_sum), _ = lax.scan(step, carry0,
-                                             jnp.arange(ticks))
-    # Mean over microbatches; scalar is the ONLY cross-stage output.
+    carry0 = (state0, jnp.zeros_like(state0), ring0, grads0, hgrads0,
+              dmb0, jnp.zeros((), jnp.float32))
+    (_, _, _, grads, hgrads, dmb, loss_sum), _ = lax.scan(
+        step, carry0, jnp.arange(ticks))
+    # Mean over microbatches; scalars/head-grads are the only
+    # cross-stage reductions (head grads live on the last stage only).
     loss = lax.psum(loss_sum / m, axis_name)
     grads = jax.tree_util.tree_map(lambda g: g / m, grads)
-    return loss, grads
+    if not with_head and not return_input_grads:
+        return loss, grads
+    hgrads = jax.tree_util.tree_map(
+        lambda g: lax.psum(g / m, axis_name), hgrads)
+    aux = {"head_grads": hgrads if with_head else None,
+           "input_grads": dmb / m if return_input_grads else None}
+    return loss, grads, aux
 
 
 def pipeline_train_sharded(stage_fn: StageFn, loss_fn: LossFn,
@@ -285,4 +328,60 @@ def pipeline_train_sharded(stage_fn: StageFn, loss_fn: LossFn,
         out_specs=(P(), pspec),
         check_vma=False)
     return fn(stacked_params, split_microbatches(x, num_microbatches),
+              split_microbatches(targets, num_microbatches))
+
+
+def pipeline_lm_train_sharded(stage_fn: StageFn, loss_fn, embed_fn,
+                              stacked_params: Any, embed_params: Any,
+                              head_params: Any, inputs: jax.Array,
+                              targets: jax.Array, mesh: Mesh,
+                              num_microbatches: int,
+                              axis_name: str = "pp"):
+    """Full-model 1F1B training step: embedding -> pp-sharded stage
+    trunk -> loss head, with exact gradients for all three param groups.
+
+    - ``embed_fn(embed_params, inputs_mb)`` maps raw microbatched inputs
+      [m, mb, ...] to trunk activations (computed replicated on every pp
+      rank — one cheap gather vs a dedicated embedding stage);
+    - ``loss_fn(y, targets_mb, head_params)`` runs on the last stage;
+    - the trunk runs the fused 1F1B schedule; stage-0 input cotangents
+      close the chain through the embedding via ``jax.vjp``.
+
+    Returns (loss, stage_grads [pp-sharded], embed_grads, head_grads)
+    with embed/head grads replicated.
+    """
+    n_stages = mesh.shape[axis_name]
+    batch_axes = data_axes(mesh)
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    xspec = P(None, batch_axes)
+
+    def inner(params, eparams, hparams, inp, tgt):
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        x_mb, embed_vjp = jax.vjp(lambda ep: embed_fn(ep, inp), eparams)
+        loss, sgrads, aux = pipeline_train_1f1b(
+            stage_fn, loss_fn, local, x_mb, tgt, n_stages,
+            axis_name=axis_name, head_params=hparams,
+            return_input_grads=True)
+        # input_grads are valid on stage 0 only; replicate around the
+        # ring, then pull the embedding gradient out of its vjp.
+        dmb = lax.psum(aux["input_grads"], axis_name)
+        (egrads,) = embed_vjp(dmb.astype(x_mb.dtype))
+        hgrads = aux["head_grads"]
+        if batch_axes:
+            mean = functools.partial(lax.pmean, axis_name=batch_axes)
+            loss = mean(loss)
+            sgrads = jax.tree_util.tree_map(mean, sgrads)
+            egrads = jax.tree_util.tree_map(mean, egrads)
+            hgrads = jax.tree_util.tree_map(mean, hgrads)
+        sgrads = jax.tree_util.tree_map(lambda g: g[None], sgrads)
+        return loss, sgrads, egrads, hgrads
+
+    espec = jax.tree_util.tree_map(lambda _: P(), embed_params)
+    hspec = jax.tree_util.tree_map(lambda _: P(), head_params)
+    fn = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(pspec, espec, hspec, xspec, xspec),
+        out_specs=(P(), pspec, espec, hspec), check_vma=False)
+    return fn(stacked_params, embed_params, head_params,
+              split_microbatches(inputs, num_microbatches),
               split_microbatches(targets, num_microbatches))
