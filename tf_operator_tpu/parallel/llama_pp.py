@@ -1,0 +1,109 @@
+"""Pipeline-parallel training step for the Llama decoder.
+
+The model's decoder blocks are already scan-stacked (params carry a
+leading [n_layers] axis, models/llama.py ScanBlocks), so pipelining is a
+reshape, not a rewrite: [L, ...] leaves become [pp, L/pp, ...] stage
+stacks, each 1F1B stage scans its L/pp layers, the embedding closes
+through stage-0 input cotangents, and final-norm + lm_head ride the
+last-stage loss head (parallel/pipeline.py pipeline_lm_train_sharded).
+
+The reference has no pipeline parallelism at all (SURVEY §2.3); this is
+the TPU-native composition: pp over ICI ring hops, dp/fsdp over the
+remaining axes, exact gradients for every parameter group.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+
+from tf_operator_tpu.models.llama import (
+    Llama,
+    LlamaBlock,
+    LlamaConfig,
+    RMSNorm,
+)
+from tf_operator_tpu.ops.layers import rope_frequencies
+from tf_operator_tpu.parallel.pipeline import pipeline_lm_train_sharded
+
+
+def split_stage_params(block_params: Any, pp: int) -> Any:
+    """[L, ...] scan-stacked block params -> [pp, L/pp, ...] stages."""
+    def reshape(p):
+        if p.shape[0] % pp:
+            raise ValueError(
+                f"n_layers {p.shape[0]} not divisible by pp={pp}")
+        return p.reshape((pp, p.shape[0] // pp) + p.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, block_params)
+
+
+def merge_stage_params(stacked: Any) -> Any:
+    """[pp, L/pp, ...] -> [L, ...] (back to the model's layout)."""
+    return jax.tree_util.tree_map(
+        lambda p: p.reshape((p.shape[0] * p.shape[1],) + p.shape[2:]),
+        stacked)
+
+
+def llama_pp_loss_and_grads(cfg: LlamaConfig, params: Dict[str, Any],
+                            tokens: jax.Array, mesh,
+                            num_microbatches: int,
+                            axis_name: str = "pp"
+                            ) -> Tuple[jax.Array, Dict[str, Any]]:
+    """One pipeline-parallel LM loss+grad evaluation.
+
+    ``params`` is the model's own tree (embed_tokens / blocks /
+    final_norm / lm_head); ``tokens`` is the [B, T+1] next-token batch
+    (the usual lm_loss contract). Returns (mean loss, grads in the same
+    tree layout as ``params``) — compose with any optax optimizer.
+    """
+    pp = mesh.shape[axis_name]
+    angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                              cfg.rope_theta)
+    stacked = split_stage_params(params["blocks"], pp)
+    embed_params = {"embed_tokens": params["embed_tokens"]}
+    head_params = {"final_norm": params["final_norm"],
+                   "lm_head": params["lm_head"]}
+    inputs = tokens[:, :-1]
+    targets = tokens[:, 1:]
+
+    block = LlamaBlock(cfg)
+
+    def stage_fn(stage_params, x):
+        # x: [mb, T, hidden]; scan this stage's L/pp layers.
+        def one(carry, layer_params):
+            y, _ = block.apply({"params": layer_params}, carry, angles)
+            return y, None
+
+        y, _ = jax.lax.scan(one, x, stage_params)
+        return y
+
+    def embed_fn(ep, tok_mb):
+        # flax nn.Embed lookup, functionally: [m, mb, T] -> [m, mb, T, H]
+        table = ep["embed_tokens"]["embedding"]
+        return table[tok_mb].astype(cfg.dtype)
+
+    def loss_fn(y, t_mb, hp):
+        from tf_operator_tpu.train.trainer import cross_entropy_loss
+
+        y = RMSNorm().apply({"params": hp["final_norm"]}, y)
+        logits = (y.astype(cfg.dtype)
+                  @ hp["lm_head"]["kernel"].astype(cfg.dtype))
+        return cross_entropy_loss(logits, t_mb)
+
+    loss, sgrads, egrads, hgrads = pipeline_lm_train_sharded(
+        stage_fn, loss_fn, embed_fn, stacked, embed_params, head_params,
+        inputs, targets, mesh, num_microbatches, axis_name=axis_name)
+    grads = {
+        "embed_tokens": egrads["embed_tokens"],
+        "blocks": merge_stage_params(sgrads),
+        "final_norm": hgrads["final_norm"],
+        "lm_head": hgrads["lm_head"],
+    }
+    return loss, grads
+
+
+def init_llama_params(cfg: LlamaConfig, rng, sample_tokens: jax.Array):
+    """Model-native init (same tree llama_pp_loss_and_grads consumes)."""
+    return Llama(cfg).init(rng, sample_tokens)["params"]

@@ -244,8 +244,24 @@ def pipeline_train_1f1b(stage_fn: StageFn, loss_fn: LossFn,
                                          keepdims=False)
         y_re, vjp_fn = jax.vjp(stage_fn, stage_params, x_res)
         t_mb = mb_at(targets, bj)
-        (loss_val, (dy_last, dhead)) = jax.value_and_grad(
-            head_loss, argnums=(0, 2))(y_re, t_mb, hp0)
+
+        # The loss head only matters on the LAST stage; a cond (legal
+        # here: no collectives inside, scalar per-device predicate)
+        # keeps the head forward+backward — for an LM, the full-vocab
+        # matmul — off the other pp-1 stages entirely.
+        def run_head(args):
+            y_h, t_h = args
+            lv, (dyl, dh) = jax.value_and_grad(
+                head_loss, argnums=(0, 2))(y_h, t_h, hp0)
+            return lv.astype(jnp.float32), dyl, dh
+
+        def skip_head(args):
+            y_h, _ = args
+            return (jnp.zeros((), jnp.float32), jnp.zeros_like(y_h),
+                    jax.tree_util.tree_map(jnp.zeros_like, hp0))
+
+        loss_val, dy_last, dhead = lax.cond(
+            stage == pp - 1, run_head, skip_head, (y_re, t_mb))
         dy = jnp.where(stage == pp - 1, dy_last, bwd_state)
         dparams, dx = vjp_fn(dy)
         # Select, don't multiply-by-zero: bubble ticks run the backward
@@ -265,8 +281,7 @@ def pipeline_train_1f1b(stage_fn: StageFn, loss_fn: LossFn,
                 dmb, jnp.where(jnp.logical_and(bwd_valid, stage == 0),
                                dx.astype(jnp.float32), mb_at(dmb, bj)),
                 jnp.clip(bj, 0, m - 1), axis=0)
-        loss_sum = loss_sum + jnp.where(
-            head_valid, loss_val.astype(jnp.float32), 0.0)
+        loss_sum = loss_sum + jnp.where(head_valid, loss_val, 0.0)
 
         # -- ring handoffs (XLA overlaps with next tick's compute) -------
         fwd_state = lax.ppermute(y, axis_name, fwd_ring)
