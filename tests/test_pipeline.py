@@ -333,3 +333,41 @@ def test_llama_pipeline_matches_serial_model():
         np.testing.assert_allclose(
             np.asarray(flat_got[path]), np.asarray(flat_want[path]),
             atol=2e-5, rtol=2e-4, err_msg=str(path))
+
+
+def test_llama_pipeline_trainer_trains():
+    """LlamaPipelineTrainer: placement (blocks pp-sharded, embed/head
+    replicated), jitted step, loss decreases on a fixed batch."""
+    import dataclasses
+
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tf_operator_tpu.models.llama import llama_tiny
+    from tf_operator_tpu.parallel.llama_pp import LlamaPipelineTrainer
+
+    cfg = dataclasses.replace(
+        llama_tiny(vocab_size=64, max_seq_len=32), n_layers=4,
+        dtype=jnp.float32, attention_impl="xla")
+    mesh = make_mesh(MeshConfig(dp=2, pp=4))
+    trainer = LlamaPipelineTrainer(cfg, mesh, optax.adam(3e-3),
+                                   num_microbatches=4)
+    rng = jax.random.PRNGKey(51)
+    tokens = jax.random.randint(jax.random.fold_in(rng, 1), (8, 17), 0,
+                                cfg.vocab_size)
+    state, shardings = trainer.init(rng, tokens[:, :-1])
+
+    # Stage stacks actually sharded over pp; embed replicated.
+    wq = state.params["blocks"]["attn"]["wq"]["kernel"]
+    assert wq.sharding.spec == P("pp")
+    assert state.params["embed_tokens"]["embedding"].sharding.spec == P()
+    mu_wq = state.opt_state[0].mu["blocks"]["attn"]["wq"]["kernel"]
+    assert mu_wq.sharding.spec == P("pp")
+
+    step = trainer.make_train_step(shardings)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, tokens)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 0.5, losses
+    assert int(state.step) == 8

@@ -14,9 +14,12 @@ remaining axes, exact gradients for every parameter group.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Tuple
 
 import jax
+import jax.numpy as jnp
+import optax
 
 from tf_operator_tpu.models.llama import (
     Llama,
@@ -49,7 +52,8 @@ def merge_stage_params(stacked: Any) -> Any:
 def llama_pp_loss_and_grads(cfg: LlamaConfig, params: Dict[str, Any],
                             tokens: jax.Array, mesh,
                             num_microbatches: int,
-                            axis_name: str = "pp"
+                            axis_name: str = "pp",
+                            staged: bool = False
                             ) -> Tuple[jax.Array, Dict[str, Any]]:
     """One pipeline-parallel LM loss+grad evaluation.
 
@@ -57,11 +61,16 @@ def llama_pp_loss_and_grads(cfg: LlamaConfig, params: Dict[str, Any],
     final_norm / lm_head); ``tokens`` is the [B, T+1] next-token batch
     (the usual lm_loss contract). Returns (mean loss, grads in the same
     tree layout as ``params``) — compose with any optax optimizer.
+
+    ``staged=True`` means ``params["blocks"]`` already carries the
+    [pp, L/pp, ...] stage layout (the pipeline trainer's canonical form)
+    and gradients come back in it too — no reshape round-trips.
     """
     pp = mesh.shape[axis_name]
     angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                               cfg.rope_theta)
-    stacked = split_stage_params(params["blocks"], pp)
+    stacked = (params["blocks"] if staged
+               else split_stage_params(params["blocks"], pp))
     embed_params = {"embed_tokens": params["embed_tokens"]}
     head_params = {"final_norm": params["final_norm"],
                    "lm_head": params["lm_head"]}
@@ -97,7 +106,7 @@ def llama_pp_loss_and_grads(cfg: LlamaConfig, params: Dict[str, Any],
         inputs, targets, mesh, num_microbatches, axis_name=axis_name)
     grads = {
         "embed_tokens": egrads["embed_tokens"],
-        "blocks": merge_stage_params(sgrads),
+        "blocks": sgrads if staged else merge_stage_params(sgrads),
         "final_norm": hgrads["final_norm"],
         "lm_head": hgrads["lm_head"],
     }
@@ -107,3 +116,92 @@ def llama_pp_loss_and_grads(cfg: LlamaConfig, params: Dict[str, Any],
 def init_llama_params(cfg: LlamaConfig, rng, sample_tokens: jax.Array):
     """Model-native init (same tree llama_pp_loss_and_grads consumes)."""
     return Llama(cfg).init(rng, sample_tokens)["params"]
+
+
+# ---------------------------------------------------------------------------
+# Pipeline trainer: 1F1B as a first-class training path
+# ---------------------------------------------------------------------------
+
+class LlamaPipelineTrainer:
+    """Trainer-shaped wrapper over the 1F1B Llama step: sharded-from-
+    birth init (blocks + their optimizer moments pp-sharded, embed/head
+    replicated), and a jitted donating ``(state, tokens) -> (state,
+    metrics)`` train step. Mirrors ``train.trainer.Trainer``'s
+    init/make_train_step flow, with raw token arrays in place of batch
+    dicts (the pipeline owns its own input split)."""
+
+    def __init__(self, cfg: LlamaConfig, mesh, optimizer,
+                 num_microbatches: int, axis_name: str = "pp"):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.optimizer = optimizer
+        self.num_microbatches = num_microbatches
+        self.axis_name = axis_name
+        self.pp = mesh.shape[axis_name]
+
+    def _placement(self, tree):
+        """Path-based placement (the robust rule the GSPMD trainer uses
+        for optimizer slots): any leaf whose path passes through
+        'blocks' is a stage stack ([pp, L/pp, ...]) sharded over pp;
+        scalars and everything else replicate. Adam mu/nu embed the
+        param path as a suffix, so the same rule places them."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        stage = NamedSharding(self.mesh, P(self.axis_name))
+        repl = NamedSharding(self.mesh, P())
+
+        def place(path, leaf):
+            names = tuple(getattr(p, "key", getattr(p, "name", str(p)))
+                          for p in path)
+            if "blocks" in names and getattr(leaf, "ndim", 0) > 0:
+                return stage
+            return repl
+
+        return jax.tree_util.tree_map_with_path(place, tree)
+
+    def init(self, rng, sample_tokens):
+        """Returns (state, state_shardings); state is created sharded
+        (jit with out_shardings — nothing materializes unsharded, the
+        GSPMD trainer's init pattern)."""
+        from tf_operator_tpu.train.trainer import TrainState
+
+        def init_fn(rng):
+            params = dict(Llama(self.cfg).init(
+                rng, sample_tokens)["params"])
+            params["blocks"] = split_stage_params(params["blocks"],
+                                                  self.pp)
+            opt_state = self.optimizer.init(params)
+            return TrainState(step=jnp.zeros((), jnp.int32),
+                              params=params, opt_state=opt_state)
+
+        abstract = jax.eval_shape(init_fn, rng)
+        shardings = TrainState(
+            step=jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec()),
+            params=self._placement(abstract.params),
+            opt_state=self._placement(abstract.opt_state))
+        state = jax.jit(init_fn, out_shardings=shardings)(rng)
+        return state, shardings
+
+    def make_train_step(self, state_shardings):
+        cfg, mesh, m = self.cfg, self.mesh, self.num_microbatches
+        axis, opt = self.axis_name, self.optimizer
+
+        @functools.partial(
+            jax.jit,
+            in_shardings=(state_shardings, None),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,))
+        def step(state, tokens):
+            loss, grads = llama_pp_loss_and_grads(cfg, state.params,
+                                                  tokens, mesh, m,
+                                                  axis_name=axis,
+                                                  staged=True)
+            updates, opt_state = opt.update(grads, state.opt_state,
+                                            state.params)
+            params = optax.apply_updates(state.params, updates)
+            new_state = state.replace(step=state.step + 1, params=params,
+                                      opt_state=opt_state)
+            return new_state, {"loss": loss}
+
+        return step
