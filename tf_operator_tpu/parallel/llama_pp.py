@@ -150,19 +150,17 @@ class LlamaPipelineTrainer:
         stage = NamedSharding(self.mesh, P(self.axis_name))
         repl = NamedSharding(self.mesh, P())
 
+        from tf_operator_tpu.train.trainer import path_names
+
         def place(path, leaf):
-            names = tuple(getattr(p, "key", getattr(p, "name", str(p)))
-                          for p in path)
-            if "blocks" in names and getattr(leaf, "ndim", 0) > 0:
+            if ("blocks" in path_names(path)
+                    and getattr(leaf, "ndim", 0) > 0):
                 return stage
             return repl
 
         return jax.tree_util.tree_map_with_path(place, tree)
 
-    def init(self, rng, sample_tokens):
-        """Returns (state, state_shardings); state is created sharded
-        (jit with out_shardings — nothing materializes unsharded, the
-        GSPMD trainer's init pattern)."""
+    def _init_fn(self, sample_tokens):
         from tf_operator_tpu.train.trainer import TrainState
 
         def init_fn(rng):
@@ -174,13 +172,28 @@ class LlamaPipelineTrainer:
             return TrainState(step=jnp.zeros((), jnp.int32),
                               params=params, opt_state=opt_state)
 
-        abstract = jax.eval_shape(init_fn, rng)
-        shardings = TrainState(
+        return init_fn
+
+    def state_shardings(self, rng, sample_tokens):
+        """Sharding tree from shapes alone (eval_shape — nothing
+        materializes): the checkpoint-restore target builder, mirroring
+        Trainer.state_shardings."""
+        from tf_operator_tpu.train.trainer import TrainState
+
+        abstract = jax.eval_shape(self._init_fn(sample_tokens), rng)
+        return TrainState(
             step=jax.sharding.NamedSharding(
                 self.mesh, jax.sharding.PartitionSpec()),
             params=self._placement(abstract.params),
             opt_state=self._placement(abstract.opt_state))
-        state = jax.jit(init_fn, out_shardings=shardings)(rng)
+
+    def init(self, rng, sample_tokens):
+        """Returns (state, state_shardings); state is created sharded
+        (jit with out_shardings — nothing materializes unsharded, the
+        GSPMD trainer's init pattern)."""
+        shardings = self.state_shardings(rng, sample_tokens)
+        state = jax.jit(self._init_fn(sample_tokens),
+                        out_shardings=shardings)(rng)
         return state, shardings
 
     def make_train_step(self, state_shardings):

@@ -27,6 +27,14 @@ from tf_operator_tpu.parallel import mesh as mesh_lib
 from tf_operator_tpu.parallel.sharding import Rules, logical_sharding
 
 
+def path_names(path) -> tuple:
+    """jax tree-path entries -> plain name tuple (DictKey.key /
+    GetAttrKey.name / str fallback), shared by every path-based
+    sharding rule."""
+    return tuple(getattr(p, "key", getattr(p, "name", str(p)))
+                 for p in path)
+
+
 @flax.struct.dataclass
 class TrainState:
     step: jax.Array
@@ -56,9 +64,7 @@ def params_shardings(mesh: Mesh, abstract_params,
     """Pytree of NamedShardings from path-based logical axes."""
 
     def to_sharding(path, leaf):
-        path_names = tuple(getattr(p, "key", getattr(p, "name", str(p)))
-                           for p in path)
-        axes = param_axes_fn(path_names, leaf)
+        axes = param_axes_fn(path_names(path), leaf)
         return logical_sharding(mesh, axes, rules)
 
     return jax.tree_util.tree_map_with_path(to_sharding, abstract_params)
@@ -74,11 +80,10 @@ def _opt_state_shardings(mesh: Mesh, abstract_opt_state,
     def place(path, leaf):
         if not hasattr(leaf, "ndim") or leaf.ndim == 0:
             return replicated
-        path_names = tuple(getattr(p, "key", getattr(p, "name", str(p)))
-                           for p in path)
-        for start in range(len(path_names)):
+        names = path_names(path)
+        for start in range(len(names)):
             try:
-                axes = param_axes_fn(path_names[start:], leaf)
+                axes = param_axes_fn(names[start:], leaf)
             except (ValueError, KeyError):
                 continue
             return logical_sharding(mesh, axes, rules)
