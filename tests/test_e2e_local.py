@@ -753,12 +753,22 @@ def test_gang_strict_head_of_line_blocks_backfill(tmp_path):
                                accelerator="v5e-8"))
         client.wait_for_condition("holder", JobConditionType.RUNNING,
                                   timeout=10)
-        # Head of queue: needs 16 chips, only 8 free.
+        # Head of queue: needs 16 chips, only 8 free. Wait for its
+        # SliceGroup to exist before submitting the next job — FIFO
+        # order is group-creation order, and group creation rides the
+        # controller sync, not job submission.
         client.create(stub_job("head", stub_dir, worker=2,
                                accelerator="v5e-16"))
+        wait_for(lambda: op.store.try_get(store_mod.SLICEGROUPS,
+                                          "default", "head") is not None,
+                 message="head slice group")
         # Would fit (8 chips free) but must not jump the queue.
         client.create(stub_job("jumper", stub_dir, worker=1,
                                accelerator="v5e-8"))
+        # Wait for the pods to EXIST (creation can lag under load),
+        # then give admission a settle window before asserting gating.
+        wait_for(lambda: client.get_pods("head")
+                 and client.get_pods("jumper"), message="gated pods exist")
         time.sleep(0.8)
         for name in ("head", "jumper"):
             pods = client.get_pods(name)

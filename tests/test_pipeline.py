@@ -382,10 +382,7 @@ def test_llama_pipeline_trainer_checkpoint_resume(tmp_path):
 
     from tf_operator_tpu.models.llama import llama_tiny
     from tf_operator_tpu.parallel.llama_pp import LlamaPipelineTrainer
-    from tf_operator_tpu.train.checkpoint import (
-        Checkpointer,
-        abstract_state_with_shardings,
-    )
+    from tf_operator_tpu.train.checkpoint import Checkpointer
 
     cfg = dataclasses.replace(
         llama_tiny(vocab_size=64, max_seq_len=32), n_layers=4,
@@ -410,17 +407,28 @@ def test_llama_pipeline_trainer_checkpoint_resume(tmp_path):
     # Restore target from shapes alone — no throwaway init.
     sh2 = trainer2.state_shardings(jax.random.PRNGKey(62),
                                    tokens[:, :-1])
-    abstract = abstract_state_with_shardings(
-        trainer2._init_fn(tokens[:, :-1]), sh2, jax.random.PRNGKey(62))
-    restored = ckpt.restore(abstract)
+    restored = ckpt.restore(trainer2.abstract_state(
+        jax.random.PRNGKey(62), tokens[:, :-1], shardings=sh2))
     assert int(restored.step) == 3
     # Restored stage stacks keep their pp sharding.
     from jax.sharding import PartitionSpec as P
     wq = restored.params["blocks"]["attn"]["wq"]["kernel"]
     assert wq.sharding.spec == P("pp")
 
+    # Optimizer moments round-trip exactly (compare BEFORE stepping:
+    # the donating step invalidates its input buffers).
+    for a, b in zip(jax.tree_util.tree_leaves(state.opt_state),
+                    jax.tree_util.tree_leaves(restored.opt_state)):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(a)),
+                                      np.asarray(jax.device_get(b)))
+
+    # Two chained steps on each side stay identical — a corrupt
+    # restored moment would diverge by the second step.
     step2 = trainer2.make_train_step(sh2)
     state_a, ma = step(state, tokens)
     state_b, mb = step2(restored, tokens)
     assert abs(float(ma["loss"]) - float(mb["loss"])) < 1e-5
+    _, ma2 = step(state_a, tokens)
+    _, mb2 = step2(state_b, tokens)
+    assert abs(float(ma2["loss"]) - float(mb2["loss"])) < 1e-5
     ckpt.close()

@@ -196,6 +196,19 @@ class LlamaPipelineTrainer:
                         out_shardings=shardings)(rng)
         return state, shardings
 
+    def abstract_state(self, rng, sample_tokens, shardings=None):
+        """Sharding-annotated abstract state without materializing
+        anything — the checkpoint-restore target (mirrors
+        Trainer.abstract_state)."""
+        from tf_operator_tpu.train.checkpoint import (
+            abstract_state_with_shardings,
+        )
+
+        if shardings is None:
+            shardings = self.state_shardings(rng, sample_tokens)
+        return abstract_state_with_shardings(
+            self._init_fn(sample_tokens), shardings, rng)
+
     def make_train_step(self, state_shardings):
         cfg, mesh, m = self.cfg, self.mesh, self.num_microbatches
         axis, opt = self.axis_name, self.optimizer
