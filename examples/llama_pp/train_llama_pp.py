@@ -1,0 +1,88 @@
+"""Pipeline-parallel Llama training (fused 1F1B schedule).
+
+No reference analog — the reference has no pipeline parallelism at all
+(SURVEY §2.3). The decoder's scan-stacked blocks re-stage over a ``pp``
+mesh axis and train under the fused 1F1B schedule with exact gradients
+for every parameter group (parallel/llama_pp.py). Runs anywhere: on one
+host it uses virtual CPU devices, on a slice the pp ring rides ICI.
+
+    python examples/llama_pp/train_llama_pp.py --pp 4 --dp 2 --steps 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pp", type=int, default=4)
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="force N virtual CPU devices (0 = use whatever "
+                         "jax.devices() offers)")
+    args = ap.parse_args()
+
+    if args.cpu_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.cpu_devices}")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.cpu_devices:
+        jax.config.update("jax_platforms", "cpu")
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tf_operator_tpu.models.llama import llama_tiny
+    from tf_operator_tpu.parallel.llama_pp import LlamaPipelineTrainer
+    from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = dataclasses.replace(
+        llama_tiny(vocab_size=512, max_seq_len=args.seq_len * 2),
+        n_layers=args.layers, attention_impl="xla")
+    need = args.pp * args.dp
+    devices = jax.devices()
+    if len(devices) < need:
+        print(f"need {need} devices for dp={args.dp} x pp={args.pp}, "
+              f"have {len(devices)}; rerun with --cpu-devices {need}")
+        return 1
+    mesh = make_mesh(MeshConfig(dp=args.dp, pp=args.pp),
+                     devices=devices[:need])
+    print("mesh:", dict(mesh.shape))
+
+    trainer = LlamaPipelineTrainer(cfg, mesh, optax.adamw(3e-3),
+                                   num_microbatches=args.microbatches)
+    rng = jax.random.PRNGKey(0)
+    data_rng = np.random.default_rng(0)
+    sample = jnp.zeros((args.batch_size, args.seq_len + 1), jnp.int32)
+    state, shardings = trainer.init(rng, sample[:, :-1])
+    step = trainer.make_train_step(shardings)
+    for i in range(args.steps):
+        tokens = jnp.asarray(data_rng.integers(
+            0, cfg.vocab_size, (args.batch_size, args.seq_len + 1)),
+            jnp.int32)
+        state, metrics = step(state, tokens)
+        print(f"step {i}: loss={float(metrics['loss']):.4f}")
+    print("llama 1F1B pipeline training OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -418,7 +418,8 @@ def test_llama_pipeline_trainer_checkpoint_resume(tmp_path):
     # Optimizer moments round-trip exactly (compare BEFORE stepping:
     # the donating step invalidates its input buffers).
     for a, b in zip(jax.tree_util.tree_leaves(state.opt_state),
-                    jax.tree_util.tree_leaves(restored.opt_state)):
+                    jax.tree_util.tree_leaves(restored.opt_state),
+                    strict=True):
         np.testing.assert_array_equal(np.asarray(jax.device_get(a)),
                                       np.asarray(jax.device_get(b)))
 
