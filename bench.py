@@ -41,8 +41,12 @@ def detect_chip() -> str:
     return "cpu" if d.platform == "cpu" else "v5e"
 
 
-def bench_resnet50(batch_size: int, image_size: int, steps: int,
-                   warmup: int):
+def build_bench_step(batch_size: int, image_size: int):
+    """The exact benchmarked program: (step_fn, state, batch).
+
+    Shared with benchmarks/profile_step.py so the profile is of this
+    step, not a re-implementation that could drift.
+    """
     import jax
     import jax.numpy as jnp
     import optax
@@ -67,7 +71,12 @@ def bench_resnet50(batch_size: int, image_size: int, steps: int,
     batch["inputs"] = batch["inputs"].astype(jnp.bfloat16)
     batch = {k: jnp.asarray(v) for k, v in batch.items()}
     state, shardings = trainer.init(rng, batch)
-    step = trainer.make_train_step(shardings, batch)
+    return trainer.make_train_step(shardings, batch), state, batch
+
+
+def bench_resnet50(batch_size: int, image_size: int, steps: int,
+                   warmup: int):
+    step, state, batch = build_bench_step(batch_size, image_size)
 
     for _ in range(warmup):
         state, metrics = step(state, batch)
@@ -128,6 +137,27 @@ def main() -> int:
                                                  steps=20, warmup=3)
             flops = imgs_per_sec * RESNET50_TRAIN_FLOPS_PER_IMAGE
             mfu = flops / PEAK_FLOPS[chip]
+            if chip == "v5e":
+                # Round-3 full-step profile (benchmarks/profile_step.py):
+                # the step is HBM-bandwidth-bound; report the profiled
+                # perfect-bandwidth floor so the headline can be read
+                # against the measured hardware ceiling, not only the
+                # 55%-MFU model-bound target. Derived from the profile
+                # JSON so a re-profile updates it.
+                try:
+                    import os
+                    prof = os.path.join(os.path.dirname(
+                        os.path.abspath(__file__)), "benchmarks",
+                        "results_profile_v5e.json")
+                    with open(prof) as f:
+                        summary = json.load(f)
+                    floor_ms = summary["perfect_bw_floor_ms"]
+                    # Only valid if the profile measured this config.
+                    if summary.get("batch_size") == 256 and floor_ms > 0:
+                        stats["platform_bw_ceiling_img_s"] = round(
+                            256 / (floor_ms / 1000))
+                except Exception:
+                    pass  # optional companion stat; never fail the bench
         print(json.dumps({
             "metric": f"resnet50_images_per_sec_per_chip[{chip}]",
             "value": round(imgs_per_sec, 2),

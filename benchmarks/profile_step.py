@@ -1,0 +1,203 @@
+"""Full-step XLA profile of the headline ResNet-50 bench step.
+
+Round-2 left the headline characterized only by microbenches; this tool
+captures the real thing: it runs bench.py's exact train step under
+``jax.profiler.trace`` (which works through the axon tunnel — the plugin
+emits a standard Chrome trace with per-op ``hlo_category``,
+``bytes_accessed`` and ``model_flops``), then aggregates a step-time
+budget:
+
+  * per-HLO-category ms/step, achieved HBM r+w GB/s, TFLOP/s, % of step
+  * time-weighted bandwidth histogram (the ceiling proof: what fraction
+    of device time runs at what fraction of the 819 GB/s v5e HBM spec)
+  * top individual fusions with shapes
+
+Usage:  python benchmarks/profile_step.py [--steps 5] [--out results.json]
+
+The parse half is pure-stdlib (gzip+json) so it runs anywhere; the trace
+half needs the chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import PEAK_FLOPS, RESNET50_TRAIN_FLOPS_PER_IMAGE  # noqa: E402
+
+HBM_GBPS = 819.0  # v5e public HBM spec
+PEAK_TFLOPS = PEAK_FLOPS["v5e"] / 1e12
+NOMINAL_TRAIN_TFLOP = RESNET50_TRAIN_FLOPS_PER_IMAGE * 256 / 1e12
+
+
+def capture_trace(steps: int, outdir: str) -> str:
+    """Run the exact bench.py step under the profiler; return the trace."""
+    import jax
+
+    from bench import build_bench_step
+
+    step, state, batch = build_bench_step(batch_size=256, image_size=224)
+    for _ in range(3):
+        state, m = step(state, batch)
+    float(m["loss"])  # host sync (block_until_ready returns early on axon)
+    with jax.profiler.trace(outdir):
+        for _ in range(steps):
+            state, m = step(state, batch)
+        float(m["loss"])
+    traces = sorted(glob.glob(os.path.join(outdir, "**", "*.trace.json.gz"),
+                              recursive=True), key=os.path.getmtime)
+    if not traces:
+        raise RuntimeError(f"profiler produced no trace under {outdir}")
+    return traces[-1]
+
+
+def parse_trace(path: str, steps: int) -> dict:
+    """Aggregate the device 'XLA Ops' track into a step budget."""
+    with gzip.open(path) as f:
+        data = json.load(f)
+    ev = data["traceEvents"]
+    device_pids = {e["pid"] for e in ev
+                   if e.get("ph") == "M" and e.get("name") == "process_name"
+                   and "TPU" in str(e.get("args", {}).get("name", ""))}
+    op_tids = {(e["pid"], e["tid"]) for e in ev
+               if e.get("ph") == "M" and e.get("name") == "thread_name"
+               and e.get("args", {}).get("name") == "XLA Ops"
+               and e["pid"] in device_pids}
+    ops = [e for e in ev if e.get("ph") == "X"
+           and (e.get("pid"), e.get("tid")) in op_tids]
+    if not ops:
+        raise SystemExit(
+            f"no device XLA-Ops events found in {path} — is this a "
+            f"host-only trace, or a plugin with different track names?")
+
+    cat = collections.defaultdict(lambda: [0.0, 0, 0, 0])
+    per_op = collections.defaultdict(lambda: [0.0, 0, 0, 0, ""])
+    hist = collections.defaultdict(float)
+    tot_us = tot_b = tot_f = 0.0
+    for e in ops:
+        a = e.get("args", {})
+        b = int(a.get("bytes_accessed", 0))
+        fl = int(a.get("model_flops", 0) or 0)
+        catname = a.get("hlo_category", "?")
+        # Async pairs (copy-start/copy-done, async-start/async-done)
+        # both carry the full transfer's bytes_accessed — verified:
+        # identical values per pair — so only the -done half counts as
+        # HBM traffic anywhere (totals, categories, per-op rows).
+        if catname.endswith("-start"):
+            b = 0
+        for agg, key in ((cat, catname), (per_op, e["name"])):
+            g = agg[key]
+            g[0] += e["dur"]; g[1] += 1; g[2] += b; g[3] += fl
+        per_op[e["name"]][4] = a.get("long_name", "")[:200]
+        tot_us += e["dur"]; tot_f += fl; tot_b += b
+        if e["dur"] >= 10:  # histogram skips latency-bound micro-ops
+            bw = b / e["dur"] * 1e6 / 1e9
+            hist[min(int(bw // 100) * 100, 1100)] += e["dur"]  # 1100 = ">=1100"
+
+    def rows(agg, top=None):
+        out = []
+        for name, g in sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]:
+            us, n, b, fl = g[:4]
+            out.append({
+                "name": name,
+                "ms_per_step": round(us / steps / 1000, 3),
+                "ops_per_step": n // steps,
+                "gbps": round(b / us * 1e6 / 1e9, 1) if us else 0.0,
+                "tflops": round(fl / us * 1e6 / 1e12, 2) if us else 0.0,
+                "pct": round(us / tot_us * 100, 1),
+            })
+        return out
+
+    shape_of = {}
+    for name, (_, _, _, _, ln) in per_op.items():
+        m = re.search(r"= \(?([a-z0-9]+\[[^\]]*\])", ln)
+        shape_of[name] = m.group(1) if m else "?"
+    top_ops = rows(per_op, top=20)
+    for r in top_ops:
+        r["shape"] = shape_of.get(r["name"], "?")
+
+    hist_total = sum(hist.values()) or 1.0
+    return {
+        "steps": steps,
+        "batch_size": 256,  # capture_trace's config; consumed by bench.py
+        "device_ms_per_step": round(tot_us / steps / 1000, 2),
+        "bytes_per_step_gb": round(tot_b / steps / 1e9, 2),
+        "model_tflop_per_step": round(tot_f / steps / 1e12, 3),
+        "nominal_tflop_per_step": round(NOMINAL_TRAIN_TFLOP, 3),
+        "aggregate_rw_gbps": round(tot_b / tot_us * 1e6 / 1e9, 1),
+        "pct_of_hbm_spec": round(tot_b / tot_us * 1e6 / 1e9 / HBM_GBPS * 100, 1),
+        "nominal_mfu_pct": round(NOMINAL_TRAIN_TFLOP * 1e12
+                                 / (tot_us / steps * 1e-6) / (PEAK_TFLOPS * 1e12)
+                                 * 100, 1),
+        "perfect_bw_floor_ms": round(tot_b / steps / (HBM_GBPS * 1e9) * 1000, 1),
+        "categories": rows(cat),
+        "top_ops": top_ops,
+        "bw_histogram_ms_per_step": {
+            (f">={k}" if k >= 1100 else f"{k}-{k + 100}"):
+                round(v / steps / 1000, 2)
+            for k, v in sorted(hist.items())},
+        "bw_histogram_pct": {
+            (f">={k}" if k >= 1100 else f"{k}-{k + 100}"):
+                round(v / hist_total * 100, 1)
+            for k, v in sorted(hist.items())},
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=None,
+                    help="steps to trace (capture mode, default 5); with "
+                         "--trace, REQUIRED: the step count the trace was "
+                         "captured with (per-step numbers divide by it)")
+    ap.add_argument("--out", default=None, help="write JSON summary here")
+    ap.add_argument("--trace", default=None,
+                    help="parse an existing *.trace.json.gz instead of running")
+    args = ap.parse_args()
+    if args.trace and args.steps is None:
+        ap.error("--trace requires --steps (the capture-time step count)")
+    if args.steps is not None and args.steps <= 0:
+        ap.error("--steps must be positive")
+    steps = args.steps if args.steps is not None else 5
+    trace = args.trace or capture_trace(steps,
+                                        tempfile.mkdtemp(prefix="jaxprof_"))
+    summary = parse_trace(trace, steps)
+
+    print(f"device time/step : {summary['device_ms_per_step']} ms")
+    print(f"bytes/step       : {summary['bytes_per_step_gb']} GB "
+          f"(r+w, as counted by XLA)")
+    print(f"aggregate r+w BW : {summary['aggregate_rw_gbps']} GB/s "
+          f"({summary['pct_of_hbm_spec']}% of {HBM_GBPS:.0f} GB/s spec)")
+    print(f"nominal MFU      : {summary['nominal_mfu_pct']}%  "
+          f"(model_flops counted by XLA: {summary['model_tflop_per_step']} "
+          f"TFLOP vs nominal {summary['nominal_tflop_per_step']})")
+    print(f"perfect-BW floor : {summary['perfect_bw_floor_ms']} ms/step")
+    print(f"\n{'category':<26}{'ms/step':>9}{'ops':>6}{'GB/s':>8}"
+          f"{'TFLOP/s':>9}{'%':>6}")
+    for r in summary["categories"]:
+        print(f"{r['name']:<26}{r['ms_per_step']:9.2f}{r['ops_per_step']:6d}"
+              f"{r['gbps']:8.1f}{r['tflops']:9.2f}{r['pct']:6.1f}")
+    print(f"\n{'top op':<26}{'ms/step':>9}{'GB/s':>8}  shape")
+    for r in summary["top_ops"]:
+        print(f"{r['name']:<26}{r['ms_per_step']:9.2f}{r['gbps']:8.1f}"
+              f"  {r['shape']}")
+    print("\ntime-weighted r+w bandwidth histogram (ops >=10us):")
+    for k, pct in summary["bw_histogram_pct"].items():
+        ms = summary["bw_histogram_ms_per_step"][k]
+        print(f"  {k:>9} GB/s: {ms:6.2f} ms ({pct:5.1f}%)")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=1)
+        print(f"\nwrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
