@@ -397,6 +397,11 @@ class SliceGroupSpec(ApiObject):
 @dataclasses.dataclass
 class SliceGroupStatus(ApiObject):
     phase: str = "Pending"  # Pending|Inqueue|Running|Unknown
+    # When the group last entered Pending (set at creation and again on
+    # preemption). Gang aging anchors here, so a re-queued group gets a
+    # fresh backfill grace window instead of blocking instantly off its
+    # old creationTimestamp.
+    pending_since: Optional[_dt.datetime] = None
 
 
 @dataclasses.dataclass

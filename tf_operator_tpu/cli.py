@@ -70,6 +70,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gang-aging-seconds", type=float, default=300.0,
                    help="wait before an unadmitted group blocks backfill "
                         "(only with --gang-fairness aged)")
+    p.add_argument("--gang-priority-classes", default="",
+                   help="priorityClass name=value map for gang admission "
+                        "ordering, e.g. 'prod=100,batch=10' (numeric "
+                        "class names need no entry)")
+    p.add_argument("--gang-queue-quotas", default="",
+                   help="per-queue chip caps for gang admission, e.g. "
+                        "'prod=32,batch=16' (queues without an entry "
+                        "share the global capacity)")
+    p.add_argument("--gang-preemption", action="store_true",
+                   help="let higher-priority groups evict admitted-but-"
+                        "not-yet-running lower-priority groups")
     p.add_argument("--monitoring-port", type=int, default=8443,
                    help="port for /metrics, /healthz "
                         "(0 = disabled, -1 = ephemeral)")
@@ -114,6 +125,18 @@ class Server:
         # thread, never on the elector's own thread.
         self.on_fatal = on_fatal
         self._lease_store = None
+        gang_kwargs = dict(
+            enable_gang_scheduling=args.enable_gang_scheduling,
+            total_chips=args.total_chips,
+            gang_fairness=args.gang_fairness,
+            gang_aging_seconds=args.gang_aging_seconds,
+            gang_priority_classes=parse_int_map(
+                getattr(args, "gang_priority_classes", ""),
+                "--gang-priority-classes"),
+            gang_queue_quotas=parse_int_map(
+                getattr(args, "gang_queue_quotas", ""),
+                "--gang-queue-quotas"),
+            gang_preemption=getattr(args, "gang_preemption", False))
         if getattr(args, "backend", "local") == "kube":
             # Cluster mode: the Store is the informer cache inside
             # KubeOperator; reads/writes/leases go to the K8s API.
@@ -135,10 +158,7 @@ class Server:
             self.operator = KubeOperator(
                 client,
                 namespace=args.namespace or None,
-                enable_gang_scheduling=args.enable_gang_scheduling,
-                total_chips=args.total_chips,
-                gang_fairness=args.gang_fairness,
-                gang_aging_seconds=args.gang_aging_seconds)
+                **gang_kwargs)
             self.store = self.operator.store
             self._lease_store = KubeLeaseStore(client)
         else:
@@ -149,11 +169,7 @@ class Server:
             self.operator = Operator(
                 store=self.store,
                 namespace=args.namespace or None,
-                enable_gang_scheduling=args.enable_gang_scheduling,
-                total_chips=args.total_chips,
-                gang_fairness=args.gang_fairness,
-                gang_aging_seconds=args.gang_aging_seconds,
-                **op_kwargs)
+                **gang_kwargs, **op_kwargs)
         self.api_server = None
         if getattr(args, "api_port", 0) != 0:
             from tf_operator_tpu.runtime.apiserver import APIServer

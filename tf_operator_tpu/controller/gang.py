@@ -2,21 +2,29 @@
 
 Reference parity: Volcano PodGroup sync (common/job_controller.go:218-322)
 and the gang annotations stamped on pods (tensorflow/pod.go:221-235).
+The PodGroup fields the reference forwards to Volcano — ``queue``,
+``priorityClassName``, ``minMember``/``minResources``
+(common/pkg/apis/common/v1/types.go:189-204, minResources from
+top-priority pods at common/job.go:423-460) — drive admission here the
+way Volcano acts on them there: priority orders the queue, queues are
+isolated admission lanes with optional capacity quotas, and preemption
+(opt-in) evicts lower-priority not-yet-running groups.
 
 TPU-native difference: the gang unit is a *slice* — admission is
 all-or-nothing against whole-slice chip capacity, not per-pod resources.
 A SliceGroup carries minMember (pod gang) plus the slice shape; the
-scheduler admits groups FIFO when the cluster's chip budget fits the
-whole request (ICI slices are indivisible). The data-plane backend holds
+scheduler admits groups when the cluster's chip budget fits the whole
+request (ICI slices are indivisible). The data-plane backend holds
 gang-scheduled pods in Pending until their group is admitted, which is
 exactly how Volcano gates pods.
 """
 
 from __future__ import annotations
 
+import datetime as _dt
 import logging
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import (
@@ -51,35 +59,64 @@ def _chips_for(group: SliceGroup) -> int:
 
 
 class SliceGangScheduler(GangScheduler):
-    """FIFO whole-slice admission. ``total_chips=None`` = unlimited capacity
-    (admission always succeeds, groups still tracked for observability).
+    """Priority/queue-aware whole-slice admission. ``total_chips=None`` =
+    unlimited capacity (admission always succeeds, groups still tracked
+    for observability).
 
-    ``fairness`` decides what happens when the FIFO head doesn't fit
-    (Volcano-style queue policy; reference Volcano does priority/queue
-    backfill):
+    Ordering: groups are considered by (priorityClass value desc,
+    creation time asc) — a higher-priority group is always offered
+    capacity first, FIFO breaks ties. ``priority_classes`` maps
+    priorityClass names to integer values (the PriorityClass-object
+    analog); a name that parses as an integer is its own value; unknown
+    names are value 0 (warned once).
+
+    Queues (``spec.queue``) are isolated admission lanes: head-of-line
+    blocking under ``strict``/``aged`` fairness applies only within the
+    blocked group's own queue, so one queue's backlog never stalls
+    another's admissions. ``queue_quotas`` optionally caps the chips a
+    queue may hold concurrently (Volcano queue-capacity analog) —
+    isolation by construction, not just by ordering.
+
+    ``fairness`` decides what happens when a group doesn't fit:
 
     - ``"backfill"``: skip it, keep admitting later smaller groups —
       maximum utilization, but a large job can starve behind a stream of
       small ones;
-    - ``"strict"``: head-of-line — nothing behind a non-fitting group
-      admits until it fits (no starvation, idles capacity);
+    - ``"strict"``: head-of-line per queue — nothing behind a
+      non-fitting group admits (in its queue) until it fits;
     - ``"aged"`` (default): backfill until a skipped group has waited
-      ``aging_seconds``; from then on it blocks all later admissions, so
-      freed capacity accumulates for it and a large job is guaranteed to
-      eventually admit under small-job churn.
+      ``aging_seconds`` since it last became Pending; from then on it
+      blocks its queue, so freed capacity accumulates for it. Priority
+      interacts: while a skipped group waits, only *equal-priority*
+      groups may backfill past it — a lower-priority group never
+      leapfrogs a waiting higher-priority one, i.e. a high-priority
+      group ages out backfill by lower-priority work immediately.
+
+    ``preemption`` (default off, Volcano's job-level preemption analog):
+    when a group doesn't fit, groups that are admitted but not yet
+    running (phase Inqueue) and have strictly lower priority are evicted
+    back to Pending — lowest priority, youngest first — until the new
+    group fits. Running groups are never preempted.
     """
 
     def __init__(self, store: Store, total_chips: Optional[int] = None,
-                 fairness: str = "aged", aging_seconds: float = 300.0):
+                 fairness: str = "aged", aging_seconds: float = 300.0,
+                 priority_classes: Optional[Dict[str, int]] = None,
+                 queue_quotas: Optional[Dict[str, int]] = None,
+                 preemption: bool = False):
         if fairness not in ("backfill", "strict", "aged"):
             raise ValueError(f"unknown gang fairness {fairness!r}")
         self.store = store
         self.total_chips = total_chips
         self.fairness = fairness
         self.aging_seconds = aging_seconds
+        self.priority_classes = dict(priority_classes or {})
+        self.queue_quotas = dict(queue_quotas or {})
+        self.preemption = preemption
         self._lock = threading.Lock()
-        # Groups already flagged infeasible (log once, not per pass).
+        # Groups already flagged infeasible / unknown-priority (log once).
         self._warned_infeasible: set = set()
+        self._warned_priority: set = set()
 
     # -- engine hooks ---------------------------------------------------
 
@@ -106,7 +143,9 @@ class SliceGangScheduler(GangScheduler):
                                       job.metadata.name)
         if existing is None:
             group = SliceGroup(spec=desired_spec,
-                               status=SliceGroupStatus(phase=PHASE_PENDING))
+                               status=SliceGroupStatus(
+                                   phase=PHASE_PENDING,
+                                   pending_since=_now()))
             group.metadata.name = job.metadata.name
             group.metadata.namespace = job.metadata.namespace
             group.metadata.labels = {constants.LABEL_JOB_NAME: job.metadata.name}
@@ -114,10 +153,26 @@ class SliceGangScheduler(GangScheduler):
             self.store.create(store_mod.SLICEGROUPS, group)
             metrics.slicegroups_created.inc(
                 job_namespace=job.metadata.namespace)
-        elif existing.spec.to_dict() != desired_spec.to_dict():
-            existing.spec = desired_spec
-            self.store.update(store_mod.SLICEGROUPS, existing)
+        else:
+            if existing.spec.to_dict() != desired_spec.to_dict():
+                existing.spec = desired_spec
+                self.store.update(store_mod.SLICEGROUPS, existing)
+            self._maybe_promote_running(existing, job)
         self._admit()
+
+    def _maybe_promote_running(self, group: SliceGroup, job: TPUJob) -> None:
+        """Inqueue -> Running once the gang actually runs (minMember pods
+        active/succeeded — Volcano PodGroup-phase analog). Running groups
+        are the preemption no-go set."""
+        if group.status.phase != PHASE_INQUEUE:
+            return
+        statuses = (job.status.replica_statuses or {}).values()
+        live = sum((rs.active or 0) + (rs.succeeded or 0) for rs in statuses)
+        if live > 0 and live >= (group.spec.min_member or 0):
+            group.status.phase = PHASE_RUNNING
+            self.store.update_status(store_mod.SLICEGROUPS, group)
+            log.info("slice group %s running (%d live pods)",
+                     group.metadata.name, live)
 
     def delete_slice_group(self, job: TPUJob) -> None:
         # try_delete's return is the atomicity seam: under concurrent
@@ -139,60 +194,180 @@ class SliceGangScheduler(GangScheduler):
 
     # -- admission ------------------------------------------------------
 
+    def _priority_of(self, group: SliceGroup) -> int:
+        name = group.spec.priority_class
+        if not name:
+            return 0
+        if name in self.priority_classes:
+            return self.priority_classes[name]
+        try:
+            return int(name)
+        except ValueError:
+            if name not in self._warned_priority:
+                self._warned_priority.add(name)
+                log.warning("unknown priorityClass %r (no entry in "
+                            "priority_classes, not numeric); treating as 0",
+                            name)
+            return 0
+
+    def _pending_since(self, group: SliceGroup) -> Optional[_dt.datetime]:
+        return group.status.pending_since or group.metadata.creation_timestamp
+
     def _admit(self) -> None:
-        """FIFO all-or-nothing: walk groups by creation order; admit while
-        the whole slice request fits the remaining chip budget, applying
-        the configured fairness when a group doesn't fit.
+        """Walk groups by (priority desc, creation asc); admit while the
+        whole slice request fits the remaining chip budget (global and
+        per-queue quota), applying fairness per queue lane when a group
+        doesn't fit and — if enabled — preempting lower-priority
+        not-yet-running groups.
 
-        Aging is anchored on the group's persisted creationTimestamp, so
-        the no-starvation guarantee survives operator restarts and
-        leader failovers (an in-memory clock would reset to zero)."""
-        import datetime as _dt
-
-        now = _dt.datetime.now(_dt.timezone.utc)
+        Aging is anchored on the group's persisted pending-since
+        timestamp (falling back to creationTimestamp), so the
+        no-starvation guarantee survives operator restarts and leader
+        failovers, and a preempted/re-queued group gets a fresh grace
+        window."""
+        now = _now()
         with self._lock:
-            groups = sorted(self.store.list(store_mod.SLICEGROUPS),
-                            key=lambda g: (g.metadata.creation_timestamp
-                                           or 0, g.metadata.name))
+            groups = sorted(
+                self.store.list(store_mod.SLICEGROUPS),
+                key=lambda g: (-self._priority_of(g),
+                               g.metadata.creation_timestamp or 0,
+                               g.metadata.name))
             live_keys = {(g.metadata.namespace, g.metadata.name)
                          for g in groups}
-            used = sum(_chips_for(g) for g in groups
-                       if g.status.phase in (PHASE_INQUEUE, PHASE_RUNNING))
+            used = 0
+            queue_used: Dict[str, int] = {}
+            for g in groups:
+                if g.status.phase in (PHASE_INQUEUE, PHASE_RUNNING):
+                    c = _chips_for(g)
+                    used += c
+                    q = g.spec.queue or ""
+                    queue_used[q] = queue_used.get(q, 0) + c
+            # Per-queue lane blocking: queue -> minimum priority still
+            # allowed to backfill (None = hard block, nothing admits).
+            blocked: Dict[str, Optional[int]] = {}
             for group in groups:
-                key = (group.metadata.namespace, group.metadata.name)
                 if group.status.phase in (PHASE_INQUEUE, PHASE_RUNNING):
                     continue
+                key = (group.metadata.namespace, group.metadata.name)
+                q = group.spec.queue or ""
                 need = _chips_for(group)
-                if self.total_chips is not None and need > self.total_chips:
-                    # Infeasible on this cluster at ANY occupancy: can
-                    # never be satisfied, so it must not block the queue
-                    # (it stays Pending; the capacity-vs-request mismatch
-                    # is the operator's to fix, not later jobs' to wait
+                pri = self._priority_of(group)
+                quota = self.queue_quotas.get(q)
+                if ((self.total_chips is not None
+                     and need > self.total_chips)
+                        or (quota is not None and need > quota)):
+                    # Infeasible at ANY occupancy (cluster- or
+                    # quota-wise): can never be satisfied, so it must not
+                    # block the lane (the capacity-vs-request mismatch is
+                    # the operator's to fix, not later jobs' to wait
                     # out). Flag once, not on every admission pass.
                     if key not in self._warned_infeasible:
                         self._warned_infeasible.add(key)
-                        log.warning("slice group %s needs %d chips but "
-                                    "the cluster has %d; skipping "
-                                    "(infeasible)", group.metadata.name,
-                                    need, self.total_chips)
+                        log.warning(
+                            "slice group %s needs %d chips but the %s "
+                            "is %s; skipping (infeasible)",
+                            group.metadata.name, need,
+                            "cluster" if (self.total_chips is not None
+                                          and need > self.total_chips)
+                            else f"queue {q!r} quota",
+                            self.total_chips
+                            if (self.total_chips is not None
+                                and need > self.total_chips) else quota)
                     continue
-                if (self.total_chips is not None
-                        and used + need > self.total_chips):
-                    created = group.metadata.creation_timestamp
-                    waited = ((now - created).total_seconds()
-                              if created is not None else 0.0)
-                    if self.fairness == "strict":
-                        break  # head-of-line: nothing behind it admits
-                    if (self.fairness == "aged"
-                            and waited >= self.aging_seconds):
-                        log.info("slice group %s aged out backfill; "
-                                 "holding capacity for it",
-                                 group.metadata.name)
-                        break
-                    continue  # backfill: later groups may still fit
+                if q in blocked:
+                    floor = blocked[q]
+                    if floor is None or pri < floor:
+                        continue  # lane held for an earlier group
+                fits = ((self.total_chips is None
+                         or used + need <= self.total_chips)
+                        and (quota is None
+                             or queue_used.get(q, 0) + need <= quota))
+                if not fits and self.preemption:
+                    fits, used, queue_used = self._try_preempt(
+                        groups, group, need, pri, q, quota,
+                        used, queue_used, now)
+                if not fits:
+                    if self.fairness == "backfill":
+                        continue  # pure skip: later groups may still fit
+                    since = self._pending_since(group)
+                    waited = ((now - since).total_seconds()
+                              if since is not None else 0.0)
+                    if (self.fairness == "strict"
+                            or waited >= self.aging_seconds):
+                        if self.fairness == "aged":
+                            log.info("slice group %s aged out backfill; "
+                                     "holding queue %r capacity for it",
+                                     group.metadata.name, q)
+                        blocked[q] = None  # hard block: lane waits
+                    else:
+                        # aged, still in grace: only equal-priority
+                        # groups may backfill this lane (sorted desc, so
+                        # floor=pri excludes exactly the lower-priority
+                        # ones — no priority inversion while it waits).
+                        if q not in blocked:
+                            blocked[q] = pri
+                    continue
                 used += need
+                queue_used[q] = queue_used.get(q, 0) + need
                 group.status.phase = PHASE_INQUEUE
                 self.store.update_status(store_mod.SLICEGROUPS, group)
-                log.info("admitted slice group %s (%d chips)",
-                         group.metadata.name, need)
+                log.info("admitted slice group %s (%d chips, queue=%r, "
+                         "priority=%d)", group.metadata.name, need, q, pri)
             self._warned_infeasible &= live_keys
+
+    def _try_preempt(self, groups: List[SliceGroup], group: SliceGroup,
+                     need: int, pri: int, q: str, quota: Optional[int],
+                     used: int, queue_used: Dict[str, int], now):
+        """Evict Inqueue (never Running) groups with strictly lower
+        priority — lowest priority first, youngest first — until
+        ``group`` fits both the global budget and its queue quota.
+        All-or-nothing: if even evicting every eligible victim wouldn't
+        fit, nothing is evicted. Returns (fits, used, queue_used)."""
+        victims = [g for g in groups
+                   if g.status.phase == PHASE_INQUEUE
+                   and self._priority_of(g) < pri]
+        victims.sort(key=lambda g: (self._priority_of(g),
+                                    -(_ts(g.metadata.creation_timestamp)),
+                                    g.metadata.name))
+        u, qu, chosen = used, dict(queue_used), []
+
+        def fits_now():
+            return ((self.total_chips is None
+                     or u + need <= self.total_chips)
+                    and (quota is None or qu.get(q, 0) + need <= quota))
+
+        for v in victims:
+            if fits_now():
+                break
+            vq = v.spec.queue or ""
+            # A victim only helps if it relieves a violated constraint:
+            # any victim relieves the global budget; only same-queue
+            # victims relieve this queue's quota.
+            global_tight = (self.total_chips is not None
+                            and u + need > self.total_chips)
+            if not global_tight and vq != q:
+                continue
+            c = _chips_for(v)
+            u -= c
+            qu[vq] = qu.get(vq, 0) - c
+            chosen.append(v)
+        if not fits_now():
+            return False, used, queue_used
+        for v in chosen:
+            v.status.phase = PHASE_PENDING
+            v.status.pending_since = now  # fresh aging grace window
+            self.store.update_status(store_mod.SLICEGROUPS, v)
+            metrics.slicegroups_preempted.inc(
+                job_namespace=v.metadata.namespace)
+            log.info("preempted slice group %s (priority %d) for %s "
+                     "(priority %d)", v.metadata.name,
+                     self._priority_of(v), group.metadata.name, pri)
+        return True, u, qu
+
+
+def _now() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def _ts(t) -> float:
+    return t.timestamp() if t is not None else 0.0

@@ -41,7 +41,10 @@ class Operator:
                  enable_gang_scheduling: bool = False,
                  total_chips: Optional[int] = None,
                  gang_fairness: str = "aged",
-                 gang_aging_seconds: float = 300.0):
+                 gang_aging_seconds: float = 300.0,
+                 gang_priority_classes: Optional[dict] = None,
+                 gang_queue_quotas: Optional[dict] = None,
+                 gang_preemption: bool = False):
         self.store = store or Store()
         self.recorder = Recorder(sink=self._persist_event)
         config = config or EngineConfig()
@@ -50,7 +53,10 @@ class Operator:
             config.enable_gang_scheduling = True
             gang = SliceGangScheduler(self.store, total_chips=total_chips,
                                       fairness=gang_fairness,
-                                      aging_seconds=gang_aging_seconds)
+                                      aging_seconds=gang_aging_seconds,
+                                      priority_classes=gang_priority_classes,
+                                      queue_quotas=gang_queue_quotas,
+                                      preemption=gang_preemption)
         self.controller = TPUJobController(self.store, recorder=self.recorder,
                                            config=config, gang=gang,
                                            namespace=namespace)

@@ -856,6 +856,9 @@ class KubeOperator:
                  total_chips: Optional[int] = None,
                  gang_fairness: str = "aged",
                  gang_aging_seconds: float = 300.0,
+                 gang_priority_classes: Optional[dict] = None,
+                 gang_queue_quotas: Optional[dict] = None,
+                 gang_preemption: bool = False,
                  config: Optional[EngineConfig] = None,
                  post_events: bool = True):
         self.client = client
@@ -868,7 +871,10 @@ class KubeOperator:
             config.enable_gang_scheduling = True
             gang = SliceGangScheduler(self.store, total_chips=total_chips,
                                       fairness=gang_fairness,
-                                      aging_seconds=gang_aging_seconds)
+                                      aging_seconds=gang_aging_seconds,
+                                      priority_classes=gang_priority_classes,
+                                      queue_quotas=gang_queue_quotas,
+                                      preemption=gang_preemption)
         self.controller = KubeJobController(client, store=self.store,
                                             recorder=recorder, config=config,
                                             gang=gang, namespace=namespace)

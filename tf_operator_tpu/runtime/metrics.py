@@ -260,6 +260,10 @@ slicegroups_created = REGISTRY.counter(
 slicegroups_deleted = REGISTRY.counter(
     "tpu_operator_slicegroups_deleted_total",
     "Counts number of gang SliceGroups deleted", ["job_namespace"])
+slicegroups_preempted = REGISTRY.counter(
+    "tpu_operator_slicegroups_preempted_total",
+    "Counts gang SliceGroups evicted back to Pending by higher-priority "
+    "admission", ["job_namespace"])
 is_leader = REGISTRY.gauge(
     "tpu_operator_is_leader",
     "1 while this operator replica holds the leader lease")
