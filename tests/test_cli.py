@@ -1,0 +1,106 @@
+"""CLI flag parsing + Server assembly regression tests.
+
+Round-3 regression: Server.__init__ referenced an undefined
+parse_int_map, so every `python -m tf_operator_tpu` invocation crashed
+with a NameError. These tests construct Server directly (with and
+without the gang flags) so the entrypoint can never ship broken again.
+Reference bar: cmd/tf-operator.v1/main.go:52-68 + app/options/options.go:53-83.
+"""
+
+import argparse
+
+import pytest
+
+from tf_operator_tpu.cli import Server, build_parser, parse_int_map
+
+
+# --- parse_int_map -------------------------------------------------------
+
+def test_parse_int_map_empty():
+    assert parse_int_map("") == {}
+    assert parse_int_map("   ") == {}
+
+
+def test_parse_int_map_single():
+    assert parse_int_map("prod=100") == {"prod": 100}
+
+
+def test_parse_int_map_multi_with_spaces():
+    assert parse_int_map("prod=100, batch=10 ,best-effort=0") == {
+        "prod": 100, "batch": 10, "best-effort": 0}
+
+
+def test_parse_int_map_negative_and_trailing_comma():
+    assert parse_int_map("low=-5,") == {"low": -5}
+
+
+def test_parse_int_map_dict_passthrough():
+    src = {"prod": 1}
+    out = parse_int_map(src)
+    assert out == src and out is not src
+
+
+def test_parse_int_map_malformed_no_equals():
+    with pytest.raises(argparse.ArgumentTypeError, match="malformed"):
+        parse_int_map("prod")
+
+
+def test_parse_int_map_malformed_empty_name():
+    with pytest.raises(argparse.ArgumentTypeError, match="malformed"):
+        parse_int_map("=5")
+
+
+def test_parse_int_map_non_integer_value():
+    with pytest.raises(argparse.ArgumentTypeError, match="not an integer"):
+        parse_int_map("prod=ten")
+
+
+# --- Server assembly -----------------------------------------------------
+
+BASE = ["--monitoring-port", "0", "--no-leader-elect"]
+
+
+def test_server_constructs_without_gang_flags():
+    server = Server(build_parser().parse_args(BASE))
+    try:
+        assert server.operator is not None
+    finally:
+        server.shutdown()
+
+
+def test_server_constructs_with_all_gang_flags():
+    args = build_parser().parse_args(BASE + [
+        "--enable-gang-scheduling", "--total-chips", "16",
+        "--gang-fairness", "aged", "--gang-aging-seconds", "60",
+        "--gang-priority-classes", "prod=100,batch=10",
+        "--gang-queue-quotas", "prod=8,batch=4",
+        "--gang-preemption"])
+    server = Server(args)
+    try:
+        gang = server.operator.controller.engine.gang
+        assert gang is not None
+        assert gang.priority_classes == {"prod": 100, "batch": 10}
+        assert gang.queue_quotas == {"prod": 8, "batch": 4}
+        assert gang.preemption is True
+    finally:
+        server.shutdown()
+
+
+def test_main_rejects_malformed_gang_map(capsys):
+    """Malformed map flags must produce an argparse usage error (exit
+    code 2 with the offending flag named), never a raw traceback."""
+    from tf_operator_tpu.cli import main
+    with pytest.raises(SystemExit) as exc:
+        main(BASE + ["--gang-priority-classes", "prod=high"])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert "--gang-priority-classes" in err
+    assert "not an integer" in err
+
+
+def test_version_wins_over_backend_validation(capsys):
+    """`--version` prints and exits even when combined with flags that
+    would otherwise fail validation (e.g. --backend none w/o api-port)."""
+    from tf_operator_tpu.cli import main
+    assert main(["--backend", "none", "--version"]) == 0
+    assert "tpu-operator" in capsys.readouterr().out

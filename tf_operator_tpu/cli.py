@@ -43,6 +43,38 @@ RENEW_DEADLINE = 5.0
 RETRY_PERIOD = 3.0
 
 
+def parse_int_map(value) -> dict:
+    """Parse 'name=int,name=int' flag values into a Dict[str, int].
+
+    argparse ``type=`` for --gang-priority-classes / --gang-queue-quotas
+    (reference analog: Volcano priorityClass/queue config maps). Empty
+    string → empty map; dicts pass through so Server(args) also accepts
+    hand-built Namespaces. ArgumentTypeError messages omit the flag name
+    — argparse prefixes it ('argument --gang-…: …').
+    """
+    if isinstance(value, dict):
+        return dict(value)
+    result: dict = {}
+    if not value or not value.strip():
+        return result
+    for entry in value.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, num = entry.partition("=")
+        name = name.strip()
+        if not sep or not name:
+            raise argparse.ArgumentTypeError(
+                f"malformed entry {entry!r}; expected 'name=int,name=int'")
+        try:
+            result[name] = int(num.strip())
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"value for {name!r} is not an integer: "
+                f"{num.strip()!r}") from None
+    return result
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpu-operator",
@@ -71,10 +103,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="wait before an unadmitted group blocks backfill "
                         "(only with --gang-fairness aged)")
     p.add_argument("--gang-priority-classes", default="",
+                   type=parse_int_map,
                    help="priorityClass name=value map for gang admission "
                         "ordering, e.g. 'prod=100,batch=10' (numeric "
                         "class names need no entry)")
     p.add_argument("--gang-queue-quotas", default="",
+                   type=parse_int_map,
                    help="per-queue chip caps for gang admission, e.g. "
                         "'prod=32,batch=16' (queues without an entry "
                         "share the global capacity)")
@@ -131,11 +165,9 @@ class Server:
             gang_fairness=args.gang_fairness,
             gang_aging_seconds=args.gang_aging_seconds,
             gang_priority_classes=parse_int_map(
-                getattr(args, "gang_priority_classes", ""),
-                "--gang-priority-classes"),
+                getattr(args, "gang_priority_classes", "")),
             gang_queue_quotas=parse_int_map(
-                getattr(args, "gang_queue_quotas", ""),
-                "--gang-queue-quotas"),
+                getattr(args, "gang_queue_quotas", "")),
             gang_preemption=getattr(args, "gang_preemption", False))
         if getattr(args, "backend", "local") == "kube":
             # Cluster mode: the Store is the informer cache inside
@@ -268,6 +300,9 @@ class Server:
 def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.version:
+        print(version_string())
+        return 0
     if args.backend == "none" and args.api_port == 0:
         parser.error("--backend none needs --api-port: without a served "
                      "API no node agent can reach the control plane, so "
@@ -278,9 +313,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                      "submitted through the served API would be dropped "
                      "on the next relist; submit TPUJob CRs to the "
                      "Kubernetes API server instead")
-    if args.version:
-        print(version_string())
-        return 0
     setup_logging(json_format=args.json_log)
     log.info("%s starting", version_string())
 
