@@ -213,6 +213,12 @@ class PodStatus(ApiObject):
     # cluster DNS names to (status.host, status.ports[...]) through the
     # control plane instead of kube-dns.
     ports: Dict[str, int] = field(default_factory=dict)
+    # Set by the data plane the moment a gang-gated pod is released past
+    # admission, BEFORE its processes spawn. Closes the eviction race:
+    # gang preemption treats a released-but-not-yet-Running pod as
+    # occupying chips (gang.py _pods_occupying), so a preemptor can
+    # never be admitted into the spawn window.
+    gang_released: bool = False
 
     def container_status(self, name: str) -> Optional[ContainerStatus]:
         for cs in self.container_statuses:

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import (
+    ObjectMeta,
     Pod,
     ReplicaSpec,
     SliceGroup,
@@ -96,14 +97,23 @@ class SliceGangScheduler(GangScheduler):
     when a group doesn't fit, groups that are admitted but not yet
     running (phase Inqueue) and have strictly lower priority are evicted
     back to Pending — lowest priority, youngest first — until the new
-    group fits. Running groups are never preempted.
+    group fits. Eviction is real: the victim's pods are deleted through
+    pod control (Volcano evicts pods, not just bookkeeping), the engine
+    recreates them, and the recreated pods re-gate on the now-Pending
+    group — so freed chips are never double-booked by a victim whose
+    pods had already passed the admission gate. Running groups (gang
+    fully up: minMember live pods, tracked from pod state each sync)
+    are never preempted; a Running group whose live count falls below
+    minMember is demoted back to Inqueue and becomes preemptible again.
     """
 
     def __init__(self, store: Store, total_chips: Optional[int] = None,
                  fairness: str = "aged", aging_seconds: float = 300.0,
                  priority_classes: Optional[Dict[str, int]] = None,
                  queue_quotas: Optional[Dict[str, int]] = None,
-                 preemption: bool = False):
+                 preemption: bool = False,
+                 pod_control=None,
+                 scheduled_pods_occupy: bool = False):
         if fairness not in ("backfill", "strict", "aged"):
             raise ValueError(f"unknown gang fairness {fairness!r}")
         self.store = store
@@ -113,6 +123,21 @@ class SliceGangScheduler(GangScheduler):
         self.priority_classes = dict(priority_classes or {})
         self.queue_quotas = dict(queue_quotas or {})
         self.preemption = preemption
+        # How preemption deletes victim pods. The owning controller
+        # binds its engine's PodControl after construction (the local
+        # backend reacts to store deletes; the kube backend issues API
+        # deletes); unbound, eviction falls back to direct store deletes.
+        self.pod_control = pod_control
+        # True when a controller auto-bound its engine control (vs an
+        # explicit pod_control= argument, which rebinds must respect).
+        self._pod_control_auto_bound = False
+        # Kube backend: a Pending pod bound to a node (ContainerCreating)
+        # already occupies its chips even though nothing stamps
+        # gang_released there; local/agent backends must NOT set this —
+        # their gate-held pods also carry node bindings, and treating
+        # those as occupying would read every freshly created gang as
+        # mid-eviction and kill its pods.
+        self.scheduled_pods_occupy = scheduled_pods_occupy
         self._lock = threading.Lock()
         # Groups already flagged infeasible / unknown-priority (log once).
         self._warned_infeasible: set = set()
@@ -161,18 +186,28 @@ class SliceGangScheduler(GangScheduler):
         self._admit()
 
     def _maybe_promote_running(self, group: SliceGroup, job: TPUJob) -> None:
-        """Inqueue -> Running once the gang actually runs (minMember pods
-        active/succeeded — Volcano PodGroup-phase analog). Running groups
-        are the preemption no-go set."""
-        if group.status.phase != PHASE_INQUEUE:
-            return
+        """Sync phase from observed pod state (Volcano PodGroup-phase
+        analog): Inqueue -> Running once the gang actually runs
+        (minMember pods active/succeeded), and Running -> Inqueue when
+        the live count drops below minMember again (a gang that lost
+        pods is no longer "fully up" and re-enters the preemptible set
+        — phase is two-way, never latched)."""
         statuses = (job.status.replica_statuses or {}).values()
         live = sum((rs.active or 0) + (rs.succeeded or 0) for rs in statuses)
-        if live > 0 and live >= (group.spec.min_member or 0):
-            group.status.phase = PHASE_RUNNING
-            self.store.update_status(store_mod.SLICEGROUPS, group)
-            log.info("slice group %s running (%d live pods)",
-                     group.metadata.name, live)
+        min_member = group.spec.min_member or 0
+        if group.status.phase == PHASE_INQUEUE:
+            if live > 0 and live >= min_member:
+                group.status.phase = PHASE_RUNNING
+                self.store.update_status(store_mod.SLICEGROUPS, group)
+                log.info("slice group %s running (%d live pods)",
+                         group.metadata.name, live)
+        elif group.status.phase == PHASE_RUNNING:
+            if live < min_member:
+                group.status.phase = PHASE_INQUEUE
+                self.store.update_status(store_mod.SLICEGROUPS, group)
+                log.info("slice group %s lost pods (%d live < minMember "
+                         "%d); demoted to Inqueue", group.metadata.name,
+                         live, min_member)
 
     def delete_slice_group(self, job: TPUJob) -> None:
         # try_delete's return is the atomicity seam: under concurrent
@@ -224,8 +259,13 @@ class SliceGangScheduler(GangScheduler):
         timestamp (falling back to creationTimestamp), so the
         no-starvation guarantee survives operator restarts and leader
         failovers, and a preempted/re-queued group gets a fresh grace
-        window."""
+        window. Mid-eviction state is likewise derived from persisted
+        observations — a Pending group with Running pods IS mid-eviction
+        (pods only run while admitted) — so a restart or failover
+        between preempting a victim and deleting its pods can never
+        drop an eviction or double-book the victim's chips."""
         now = _now()
+        to_evict: List[tuple] = []
         with self._lock:
             groups = sorted(
                 self.store.list(store_mod.SLICEGROUPS),
@@ -236,8 +276,25 @@ class SliceGangScheduler(GangScheduler):
                          for g in groups}
             used = 0
             queue_used: Dict[str, int] = {}
+            # Groups not admissible this pass because their pods still
+            # occupy chips: Pending phase + Running pods = a preempted
+            # victim whose eviction hasn't completed (or a gate race
+            # about to be corrected). Their chips stay counted and
+            # their pods get (re-)deleted below — level-triggered, so
+            # failed deletes retry on every pass with no extra state.
+            evicting = set()
+            # One pod-store scan per pass; mid-eviction state can only
+            # exist when preemption is on (nothing else flips a group
+            # with released pods back to Pending).
+            occ_index = self._occupancy_index() if self.preemption else {}
             for g in groups:
-                if g.status.phase in (PHASE_INQUEUE, PHASE_RUNNING):
+                gk = (g.metadata.namespace, g.metadata.name)
+                occupied = g.status.phase in (PHASE_INQUEUE, PHASE_RUNNING)
+                if g.status.phase == PHASE_PENDING and occ_index.get(gk):
+                    evicting.add(gk)
+                    to_evict.append(gk)
+                    occupied = True
+                if occupied:
                     c = _chips_for(g)
                     used += c
                     q = g.spec.queue or ""
@@ -245,10 +302,19 @@ class SliceGangScheduler(GangScheduler):
             # Per-queue lane blocking: queue -> minimum priority still
             # allowed to backfill (None = hard block, nothing admits).
             blocked: Dict[str, Optional[int]] = {}
+            # Chips held back for aged-out groups. Their lane block alone
+            # can't protect them: the chip budget is global, so backfill
+            # from *other* queues would otherwise keep consuming freed
+            # capacity and starve them indefinitely. Reserving makes the
+            # docstring's "freed capacity accumulates for it" true
+            # cluster-wide, not just within the blocked lane.
+            reserved = 0
             for group in groups:
                 if group.status.phase in (PHASE_INQUEUE, PHASE_RUNNING):
                     continue
                 key = (group.metadata.namespace, group.metadata.name)
+                if key in evicting:
+                    continue  # mid-eviction: not admissible until done
                 q = group.spec.queue or ""
                 need = _chips_for(group)
                 pri = self._priority_of(group)
@@ -279,13 +345,25 @@ class SliceGangScheduler(GangScheduler):
                     if floor is None or pri < floor:
                         continue  # lane held for an earlier group
                 fits = ((self.total_chips is None
-                         or used + need <= self.total_chips)
+                         or used + reserved + need <= self.total_chips)
                         and (quota is None
                              or queue_used.get(q, 0) + need <= quota))
                 if not fits and self.preemption:
-                    fits, used, queue_used = self._try_preempt(
+                    fits, used, queue_used, ev_pending = self._try_preempt(
                         groups, group, need, pri, q, quota,
-                        used, queue_used, now)
+                        used, queue_used, reserved, now,
+                        evicting, to_evict, occ_index)
+                    if not fits and ev_pending:
+                        # Chips are inbound for THIS group (victims died
+                        # or are dying for it). Earmark them — lane block
+                        # plus a global reservation — so no lower-priority
+                        # group later in this pass (or cross-queue
+                        # backfill) admits onto capacity the eviction just
+                        # paid for; the preemptor lands next pass when the
+                        # deletes are confirmed.
+                        reserved += need
+                        blocked[q] = None
+                        continue
                 if not fits:
                     if self.fairness == "backfill":
                         continue  # pure skip: later groups may still fit
@@ -296,8 +374,13 @@ class SliceGangScheduler(GangScheduler):
                             or waited >= self.aging_seconds):
                         if self.fairness == "aged":
                             log.info("slice group %s aged out backfill; "
-                                     "holding queue %r capacity for it",
-                                     group.metadata.name, q)
+                                     "reserving %d chips for it",
+                                     group.metadata.name, need)
+                            # Hold its chips out of the global budget so
+                            # cross-queue backfill can't eat freed
+                            # capacity (strict mode stays per-queue by
+                            # design: lane isolation is its contract).
+                            reserved += need
                         blocked[q] = None  # hard block: lane waits
                     else:
                         # aged, still in grace: only equal-priority
@@ -314,45 +397,103 @@ class SliceGangScheduler(GangScheduler):
                 log.info("admitted slice group %s (%d chips, queue=%r, "
                          "priority=%d)", group.metadata.name, need, q, pri)
             self._warned_infeasible &= live_keys
+        # Pod deletes are API I/O on the kube backend — never under the
+        # lock. Completed evictions free their chips on the next pass
+        # (triggered by the pods' DELETED events re-enqueuing jobs);
+        # failed deletes are retried because the next pass re-derives
+        # the same group from its still-occupying pods. Local-backend
+        # caveat: the store delete precedes process SIGTERM by up to the
+        # termination grace (~3s), so a preemptor admitted on the next
+        # pass can briefly overlap the dying processes — the same
+        # overlap kubelet's grace period produces; chip *accounting*
+        # converges either way.
+        for ns, name in to_evict:
+            self._evict_pods(ns, name)
 
     def _try_preempt(self, groups: List[SliceGroup], group: SliceGroup,
                      need: int, pri: int, q: str, quota: Optional[int],
-                     used: int, queue_used: Dict[str, int], now):
+                     used: int, queue_used: Dict[str, int],
+                     reserved: int, now,
+                     evicting: set, to_evict: List[tuple],
+                     occ_index: Dict[tuple, List[Pod]]):
         """Evict Inqueue (never Running) groups with strictly lower
         priority — lowest priority first, youngest first — until
-        ``group`` fits both the global budget and its queue quota.
-        All-or-nothing: if even evicting every eligible victim wouldn't
-        fit, nothing is evicted. Returns (fits, used, queue_used)."""
+        ``group`` fits both the global budget (minus chips reserved for
+        aged-out groups) and its queue quota. All-or-nothing: if even
+        evicting every eligible victim wouldn't fit, nothing is evicted.
+
+        Eviction = flip the SliceGroup to Pending AND delete its pods.
+        A victim with no released pods frees its chips immediately (the
+        preemptor can admit in this very pass); a victim whose pods
+        passed the admission gate keeps its chips *counted* — and stays
+        in ``evicting`` — until a later _admit pass observes every pod
+        deleted (triggered by the pods' DELETED events re-enqueuing
+        jobs) and the preemptor admits then.
+
+        Chips already in flight from earlier evictions (the mid-eviction
+        groups in ``evicting``) are credited before choosing new
+        victims: if inbound capacity alone will fit the preemptor, no
+        additional gang is killed for it (no over-preemption while
+        deletes land).
+
+        Returns (fits, used, queue_used, pending) where ``pending``
+        means capacity is inbound for this group — victims were just
+        evicted or are mid-eviction — and the caller must earmark it.
+        """
+        def fits(u_, qu_):
+            return ((self.total_chips is None
+                     or u_ + reserved + need <= self.total_chips)
+                    and (quota is None or qu_.get(q, 0) + need <= quota))
+
+        # Credit for evictions already in flight: their chips are in
+        # `used`/`queue_used` now but are guaranteed to free (their
+        # groups are Pending; their pods are being deleted on every
+        # pass). Credited globally AND per queue — a quota-bound
+        # preemptor must not kill a fresh same-queue victim when an
+        # earlier same-queue eviction is already freeing enough.
+        in_flight = 0
+        in_flight_q: Dict[str, int] = {}
+        for g in groups:
+            if (g.metadata.namespace, g.metadata.name) in evicting:
+                c = _chips_for(g)
+                in_flight += c
+                gq = g.spec.queue or ""
+                in_flight_q[gq] = in_flight_q.get(gq, 0) + c
+        qu_credit = {k: queue_used.get(k, 0) - in_flight_q.get(k, 0)
+                     for k in set(queue_used) | set(in_flight_q)}
+        if in_flight and fits(used - in_flight, qu_credit):
+            return False, used, queue_used, True  # wait, don't kill more
+
         victims = [g for g in groups
                    if g.status.phase == PHASE_INQUEUE
                    and self._priority_of(g) < pri]
         victims.sort(key=lambda g: (self._priority_of(g),
                                     -(_ts(g.metadata.creation_timestamp)),
                                     g.metadata.name))
-        u, qu, chosen = used, dict(queue_used), []
-
-        def fits_now():
-            return ((self.total_chips is None
-                     or u + need <= self.total_chips)
-                    and (quota is None or qu.get(q, 0) + need <= quota))
-
+        u, qu, chosen = used - in_flight, qu_credit, []
         for v in victims:
-            if fits_now():
+            if fits(u, qu):
                 break
             vq = v.spec.queue or ""
             # A victim only helps if it relieves a violated constraint:
             # any victim relieves the global budget; only same-queue
             # victims relieve this queue's quota.
             global_tight = (self.total_chips is not None
-                            and u + need > self.total_chips)
+                            and u + reserved + need > self.total_chips)
             if not global_tight and vq != q:
                 continue
             c = _chips_for(v)
             u -= c
             qu[vq] = qu.get(vq, 0) - c
             chosen.append(v)
-        if not fits_now():
-            return False, used, queue_used
+        if not fits(u, qu):
+            return False, used, queue_used, False
+        # Feasible: flip every chosen victim Pending (pods the engine
+        # recreates re-gate on the unadmitted group), then free chips
+        # only for victims with no released pods; the rest stay counted
+        # — and excluded from this pass's admission walk — until their
+        # deletes land (the preemptor admits on a later pass).
+        u, qu = used, dict(queue_used)
         for v in chosen:
             v.status.phase = PHASE_PENDING
             v.status.pending_since = now  # fresh aging grace window
@@ -362,7 +503,88 @@ class SliceGangScheduler(GangScheduler):
             log.info("preempted slice group %s (priority %d) for %s "
                      "(priority %d)", v.metadata.name,
                      self._priority_of(v), group.metadata.name, pri)
-        return True, u, qu
+            vk = (v.metadata.namespace, v.metadata.name)
+            # Either way the victim is out of this pass's admission walk
+            # (it sorts after the higher-priority preemptor and must not
+            # re-admit onto the chips it just gave up).
+            evicting.add(vk)
+            if occ_index.get(vk):
+                to_evict.append(vk)
+            else:
+                c = _chips_for(v)
+                u -= c
+                vq = v.spec.queue or ""
+                qu[vq] = qu.get(vq, 0) - c
+        return fits(u, qu), u, qu, True
+
+    def _pod_occupies(self, p: Pod) -> bool:
+        """Whether a pod actually holds chips: phase Running; released
+        past the admission gate and mid-spawn (gang_released — the
+        local/agent data plane stamps it before spawning, closing the
+        race where a preemptor admits into the spawn window); or, on
+        the kube backend, bound to a node while containers create
+        (scheduled_pods_occupy). Gate-held Pending pods occupy nothing
+        and are the engine's to manage; terminal pods hold no chips and
+        carry completion records (deleting a Succeeded pod would re-run
+        finished work on re-admission), so eviction never touches
+        either."""
+        if p.status.phase == "Running":
+            return True
+        if p.status.phase != "Pending":
+            return False
+        return bool(p.status.gang_released
+                    or (self.scheduled_pods_occupy and p.spec.node_name))
+
+    def _pods_occupying(self, ns: str, group_name: str) -> List[Pod]:
+        return [p for p in self.store.list(
+                    store_mod.PODS, namespace=ns,
+                    selector={constants.LABEL_JOB_NAME: group_name})
+                if self._pod_occupies(p)]
+
+    def _occupancy_index(self) -> Dict[tuple, List[Pod]]:
+        """(namespace, group) -> occupying pods, from ONE pod-store scan
+        — the per-pass probe must not do a full list per Pending group
+        under the scheduler lock."""
+        index: Dict[tuple, List[Pod]] = {}
+        for p in self.store.list(store_mod.PODS):
+            if not self._pod_occupies(p):
+                continue
+            group = p.metadata.labels.get(constants.LABEL_JOB_NAME, "")
+            if group:
+                index.setdefault((p.metadata.namespace, group),
+                                 []).append(p)
+        return index
+
+    def _evict_pods(self, ns: str, name: str) -> None:
+        """Delete a preempted group's Running pods (Volcano evicts pods;
+        accounting-only eviction would let a victim whose pods already
+        passed the admission gate keep running on chips handed to the
+        preemptor). Failures only log: the next admission pass
+        re-derives the victim from its still-Running pods and retries —
+        and keeps its chips counted meanwhile, so a failed delete can
+        never double-book. Runs without the scheduler lock (deletes are
+        API I/O on the kube backend)."""
+        job = self.store.try_get(store_mod.TPUJOBS, ns, name)
+        if job is None and self.pod_control is not None:
+            # Job already deleted mid-eviction: synthesize a reference
+            # for event attribution so eviction still goes through pod
+            # control (a store-level delete would only touch the kube
+            # backend's informer mirror, not the cluster).
+            job = TPUJob(metadata=ObjectMeta(name=name, namespace=ns))
+        for pod in self._pods_occupying(ns, name):
+            try:
+                # Both controls swallow NotFound themselves (deletion is
+                # level-triggered); anything else logs and retries next
+                # pass.
+                if self.pod_control is not None:
+                    self.pod_control.delete_pod(ns, pod.metadata.name, job)
+                else:
+                    self.store.try_delete(store_mod.PODS, ns,
+                                          pod.metadata.name)
+            except Exception as e:
+                log.warning("evicting pod %s/%s of preempted group %s "
+                            "failed (will retry): %s",
+                            ns, pod.metadata.name, name, e)
 
 
 def _now() -> _dt.datetime:

@@ -139,6 +139,13 @@ class TPUJobController(JobPlugin):
             gang=gang,
             config=config,
         )
+        if gang is not None and getattr(gang, "pod_control", None) is None:
+            # Preemption evicts victim pods through the same control the
+            # engine uses (KubeJobController re-binds after swapping in
+            # its API-backed control; an explicitly passed pod_control
+            # is never overwritten — see _pod_control_auto_bound).
+            gang.pod_control = self.engine.pod_control
+            gang._pod_control_auto_bound = True
         self._watchers = []
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()

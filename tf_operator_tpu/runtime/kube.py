@@ -752,6 +752,13 @@ class KubeJobController(TPUJobController):
         self.engine.pod_control = KubePodControl(client, self.recorder)
         self.engine.endpoint_control = KubeEndpointControl(client,
                                                            self.recorder)
+        if (self.engine.gang is not None
+                and getattr(self.engine.gang, "_pod_control_auto_bound",
+                            False)):
+            # Re-bind only the base class's auto-bound store control —
+            # evictions must go through the API server here. An
+            # explicitly constructed pod_control is respected.
+            self.engine.gang.pod_control = self.engine.pod_control
 
     def update_job_status_in_api(self, job: TPUJob) -> None:
         """Status-subresource merge patch (reference
@@ -874,7 +881,12 @@ class KubeOperator:
                                       aging_seconds=gang_aging_seconds,
                                       priority_classes=gang_priority_classes,
                                       queue_quotas=gang_queue_quotas,
-                                      preemption=gang_preemption)
+                                      preemption=gang_preemption,
+                                      # Node-bound Pending pods (container
+                                      # creating) already hold chips here;
+                                      # nothing stamps gang_released on
+                                      # the kube data plane.
+                                      scheduled_pods_occupy=True)
         self.controller = KubeJobController(client, store=self.store,
                                             recorder=recorder, config=config,
                                             gang=gang, namespace=namespace)

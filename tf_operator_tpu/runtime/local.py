@@ -201,9 +201,38 @@ class LocalProcessBackend:
                                        pod.metadata.namespace, group_name)
             if group is not None and group.status.phase in (PHASE_INQUEUE,
                                                             PHASE_RUNNING):
-                return True
+                # Mark-then-recheck, not check-then-mark: persist the
+                # release FIRST, then confirm the group is still
+                # admitted. A preemption between our phase read and the
+                # marker write would otherwise see no occupying pod and
+                # hand these chips to the preemptor while we spawn.
+                self._mark_released(pod, True)
+                group = self.store.try_get(store_mod.SLICEGROUPS,
+                                           pod.metadata.namespace,
+                                           group_name)
+                if group is not None and group.status.phase in (
+                        PHASE_INQUEUE, PHASE_RUNNING):
+                    return True
+                self._mark_released(pod, False)  # lost the race: re-gate
+                continue
             time.sleep(0.05)
         return False
+
+    def _mark_released(self, pod: Pod, released: bool) -> None:
+        """Persist gang_released BEFORE spawning, so the gang scheduler
+        counts this pod as occupying chips through the whole spawn
+        window — a preemption landing mid-spawn evicts it instead of
+        double-booking its chips (see PodStatus.gang_released)."""
+        stored = self.store.try_get(store_mod.PODS, pod.metadata.namespace,
+                                    pod.metadata.name)
+        if stored is None:
+            return
+        stored.status.gang_released = released
+        pod.status.gang_released = released
+        try:
+            self.store.update_status(store_mod.PODS, stored)
+        except store_mod.NotFoundError:
+            pass
 
     def _spawn_all(self, rp: _RunningPod) -> None:
         for container in rp.pod.spec.containers:
