@@ -41,7 +41,8 @@ def detect_chip() -> str:
     return "cpu" if d.platform == "cpu" else "v5e"
 
 
-def build_bench_step(batch_size: int, image_size: int):
+def build_bench_step(batch_size: int, image_size: int,
+                     stem: str = "conv7", steps_per_call: int = 1):
     """The exact benchmarked program: (step_fn, state, batch).
 
     Shared with benchmarks/profile_step.py so the profile is of this
@@ -57,7 +58,7 @@ def build_bench_step(batch_size: int, image_size: int):
     from tf_operator_tpu.train.trainer import Trainer, classification_loss
 
     mesh = make_mesh(MeshConfig(dp=-1), devices=jax.devices()[:1])
-    cfg = rn.resnet50()
+    cfg = rn.resnet50(stem=stem)
     trainer = Trainer(model=rn.ResNet(cfg), param_axes_fn=rn.param_logical_axes,
                       rules=CNN_RULES, mesh=mesh,
                       optimizer=optax.sgd(0.1, momentum=0.9),
@@ -71,12 +72,24 @@ def build_bench_step(batch_size: int, image_size: int):
     batch["inputs"] = batch["inputs"].astype(jnp.bfloat16)
     batch = {k: jnp.asarray(v) for k, v in batch.items()}
     state, shardings = trainer.init(rng, batch)
-    return trainer.make_train_step(shardings, batch), state, batch
+    return (trainer.make_train_step(shardings, batch,
+                                    steps_per_call=steps_per_call),
+            state, batch)
 
 
 def bench_resnet50(batch_size: int, image_size: int, steps: int,
-                   warmup: int):
-    step, state, batch = build_bench_step(batch_size, image_size)
+                   warmup: int, stem: str = "conv7",
+                   steps_per_call: int = 1):
+    """``steps``/``warmup`` count optimizer steps; with
+    ``steps_per_call > 1`` they are grouped into scan-fused dispatches
+    (steps must divide evenly)."""
+    assert steps % steps_per_call == 0 and warmup % steps_per_call == 0
+    step, state, batch = build_bench_step(batch_size, image_size,
+                                          stem=stem,
+                                          steps_per_call=steps_per_call)
+    warmup //= steps_per_call
+    steps //= steps_per_call
+    batch_size *= steps_per_call  # images per dispatch
 
     for _ in range(warmup):
         state, metrics = step(state, batch)
@@ -132,9 +145,16 @@ def main() -> int:
                                                  steps=3, warmup=1)
             mfu = 0.0
         else:
+            # Measured config (docs/benchmarks.md round-4 A/B table):
+            # space-to-depth stem (exact 7x7 rewrite, MXU-shaped) and
+            # 32-step scan-fused dispatch (amortizes the per-dispatch
+            # host/tunnel cost the sync_corrected stat used to estimate
+            # out). Batch 256/chip as in rounds 1-3.
             imgs_per_sec, stats = bench_resnet50(batch_size=256,
                                                  image_size=224,
-                                                 steps=20, warmup=3)
+                                                 steps=96, warmup=32,
+                                                 stem="s2d",
+                                                 steps_per_call=32)
             flops = imgs_per_sec * RESNET50_TRAIN_FLOPS_PER_IMAGE
             mfu = flops / PEAK_FLOPS[chip]
             if chip == "v5e":

@@ -39,13 +39,14 @@ PEAK_TFLOPS = PEAK_FLOPS["v5e"] / 1e12
 NOMINAL_TRAIN_TFLOP = RESNET50_TRAIN_FLOPS_PER_IMAGE * 256 / 1e12
 
 
-def capture_trace(steps: int, outdir: str) -> str:
+def capture_trace(steps: int, outdir: str, stem: str = "conv7") -> str:
     """Run the exact bench.py step under the profiler; return the trace."""
     import jax
 
     from bench import build_bench_step
 
-    step, state, batch = build_bench_step(batch_size=256, image_size=224)
+    step, state, batch = build_bench_step(batch_size=256, image_size=224,
+                                          stem=stem)
     for _ in range(3):
         state, m = step(state, batch)
     float(m["loss"])  # host sync (block_until_ready returns early on axon)
@@ -161,6 +162,8 @@ def main() -> None:
     ap.add_argument("--out", default=None, help="write JSON summary here")
     ap.add_argument("--trace", default=None,
                     help="parse an existing *.trace.json.gz instead of running")
+    ap.add_argument("--stem", default="conv7", choices=("conv7", "s2d"),
+                    help="ResNet stem variant to profile (capture mode)")
     args = ap.parse_args()
     if args.trace and args.steps is None:
         ap.error("--trace requires --steps (the capture-time step count)")
@@ -168,7 +171,8 @@ def main() -> None:
         ap.error("--steps must be positive")
     steps = args.steps if args.steps is not None else 5
     trace = args.trace or capture_trace(steps,
-                                        tempfile.mkdtemp(prefix="jaxprof_"))
+                                        tempfile.mkdtemp(prefix="jaxprof_"),
+                                        stem=args.stem)
     summary = parse_trace(trace, steps)
 
     print(f"device time/step : {summary['device_ms_per_step']} ms")

@@ -151,6 +151,114 @@ def test_llama_ring_attention_matches_plain():
     assert abs(float(l_plain) - float(l_ring)) < 2e-3
 
 
+def test_multi_step_dispatch_matches_single_steps():
+    """steps_per_call=K with stacked batches computes the same training
+    trajectory as K single-step dispatches (scan fusion is a dispatch
+    optimization, not a semantics change), and batch shardings land on
+    the batch dim (dim 1 of the stack), not the step dim."""
+    mesh = make_mesh(MeshConfig(dp=-1))
+    cfg = rn.resnet_tiny()
+
+    def make():
+        return Trainer(model=rn.ResNet(cfg),
+                       param_axes_fn=rn.param_logical_axes,
+                       rules=CNN_RULES, mesh=mesh,
+                       optimizer=optax.sgd(0.1),
+                       loss_fn=classification_loss)
+
+    rng = jax.random.PRNGKey(0)
+    batches = [rn.synthetic_batch(jax.random.PRNGKey(i), batch_size=16,
+                                  image_size=32, num_classes=10)
+               for i in range(4)]
+    batches = [
+        {k: jnp.asarray(v) for k, v in b.items()} for b in batches]
+
+    tr = make()
+    state, sh = tr.init(rng, batches[0])
+    single = tr.make_train_step(sh, batches[0])
+    for b in batches:
+        state, m_single = single(state, b)
+
+    tr2 = make()
+    state2, sh2 = tr2.init(rng, batches[0])
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+    multi = tr2.make_train_step(sh2, batches[0], steps_per_call=4,
+                                stacked_batches=True)
+    state2, m_multi = multi(state2, stacked)
+
+    assert int(m_multi["step"]) == int(m_single["step"])
+    np.testing.assert_allclose(float(m_multi["loss"]),
+                               float(m_single["loss"]),
+                               rtol=1e-4, atol=1e-4)
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(state2.params)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_multi_step_dispatch_same_batch_mode():
+    """stacked_batches=False repeats one batch for K inner steps (the
+    synthetic-bench mode): K optimizer steps happen per dispatch."""
+    mesh = make_mesh(MeshConfig(dp=-1))
+    cfg = rn.resnet_tiny()
+    tr = Trainer(model=rn.ResNet(cfg), param_axes_fn=rn.param_logical_axes,
+                 rules=CNN_RULES, mesh=mesh, optimizer=optax.adam(1e-3),
+                 loss_fn=classification_loss)
+    rng = jax.random.PRNGKey(0)
+    batch = rn.synthetic_batch(rng, batch_size=16, image_size=32,
+                               num_classes=10)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()}
+    state, sh = tr.init(rng, batch)
+    step = tr.make_train_step(sh, batch, steps_per_call=3)
+    state, m = step(state, batch)
+    assert int(m["step"]) == 2  # last inner step's pre-increment counter
+    state, m = step(state, batch)
+    assert int(m["step"]) == 5
+
+
+def test_resnet_s2d_stem_exact_vs_conv7():
+    """The space-to-depth stem computes the SAME function as the
+    classic 7x7/stride-2 stem when its kernel is derived via
+    s2d_stem_kernel (MLPerf-ResNet transform, used by the bench).
+    Compared in f32 to isolate math from bf16 rounding."""
+    rng = jax.random.PRNGKey(7)
+    x = jax.random.normal(rng, (2, 224, 224, 3), dtype=jnp.float32)
+    w7 = jax.random.normal(jax.random.PRNGKey(8), (7, 7, 3, 64),
+                           dtype=jnp.float32) * 0.1
+
+    ref = jax.lax.conv_general_dilated(
+        x, w7, window_strides=(2, 2), padding=[(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    w4 = rn.s2d_stem_kernel(w7)
+    got = jax.lax.conv_general_dilated(
+        rn.space_to_depth(x, 2), w4, window_strides=(1, 1),
+        padding=[(2, 1), (2, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    assert ref.shape == got.shape == (2, 112, 112, 64)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_resnet_s2d_stem_trains():
+    mesh = make_mesh(MeshConfig(dp=-1))
+    cfg = dataclasses.replace(rn.resnet_tiny(), stem="s2d")
+    tr = Trainer(model=rn.ResNet(cfg), param_axes_fn=rn.param_logical_axes,
+                 rules=CNN_RULES, mesh=mesh, optimizer=optax.adam(1e-3),
+                 loss_fn=classification_loss)
+    rng = jax.random.PRNGKey(0)
+    batch = rn.synthetic_batch(rng, batch_size=16, image_size=32,
+                               num_classes=10)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()}
+    state, shardings = tr.init(rng, batch)
+    step = tr.make_train_step(shardings, batch)
+    losses = []
+    for _ in range(5):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+
+
 def test_resnet_trains_with_batchnorm():
     mesh = make_mesh(MeshConfig(dp=-1))
     cfg = rn.resnet_tiny()

@@ -508,7 +508,12 @@ class SliceGangScheduler(GangScheduler):
             # (it sorts after the higher-priority preemptor and must not
             # re-admit onto the chips it just gave up).
             evicting.add(vk)
-            if occ_index.get(vk):
+            # Fresh store read, NOT the pass-start occ_index snapshot: a
+            # pod can pass the gate (gang_released persisted) between
+            # the snapshot and this flip, and freeing its chips off the
+            # stale snapshot would admit the preemptor into the spawn
+            # window.
+            if self._pods_occupying(*vk):
                 to_evict.append(vk)
             else:
                 c = _chips_for(v)
@@ -541,18 +546,21 @@ class SliceGangScheduler(GangScheduler):
                     selector={constants.LABEL_JOB_NAME: group_name})
                 if self._pod_occupies(p)]
 
-    def _occupancy_index(self) -> Dict[tuple, List[Pod]]:
-        """(namespace, group) -> occupying pods, from ONE pod-store scan
-        — the per-pass probe must not do a full list per Pending group
-        under the scheduler lock."""
-        index: Dict[tuple, List[Pod]] = {}
-        for p in self.store.list(store_mod.PODS):
+    def _occupancy_index(self) -> Dict[tuple, int]:
+        """(namespace, group) -> occupying-pod count, from ONE
+        deepcopy-free pod-store projection — the per-pass probe must
+        not do a full list per Pending group under the scheduler
+        lock."""
+        index: Dict[tuple, int] = {}
+
+        def key_of(p):
             if not self._pod_occupies(p):
-                continue
+                return None
             group = p.metadata.labels.get(constants.LABEL_JOB_NAME, "")
-            if group:
-                index.setdefault((p.metadata.namespace, group),
-                                 []).append(p)
+            return (p.metadata.namespace, group) if group else None
+
+        for k in self.store.project(store_mod.PODS, key_of):
+            index[k] = index.get(k, 0) + 1
         return index
 
     def _evict_pods(self, ns: str, name: str) -> None:

@@ -36,10 +36,20 @@ class ResNetConfig:
     # with update_stats=False to normalize with running stats (pure
     # affine, no reduces) — see Trainer stats_every_n.
     norm: str = "bn"
+    # Stem form:
+    #   "conv7"  classic 7x7/stride-2 conv on [N,224,224,3]
+    #   "s2d"    space-to-depth: block-2 rearrange to [N,112,112,12]
+    #            then a 4x4/stride-1 conv — mathematically the same
+    #            function (see s2d_stem_kernel for the exact weight
+    #            map), but MXU-shaped: the C=3 7x7 stride-2 conv is the
+    #            profile's slowest op class (400-600 GB/s vs the 819
+    #            HBM spec) because 3 input channels waste the systolic
+    #            array's 128 lanes. The MLPerf-ResNet standard form.
+    stem: str = "conv7"
 
 
-def resnet50(num_classes: int = 1000) -> ResNetConfig:
-    return ResNetConfig(num_classes=num_classes)
+def resnet50(num_classes: int = 1000, stem: str = "conv7") -> ResNetConfig:
+    return ResNetConfig(num_classes=num_classes, stem=stem)
 
 
 def resnet_tiny(num_classes: int = 10) -> ResNetConfig:
@@ -124,9 +134,17 @@ class ResNet(nn.Module):
                  update_stats: bool = True) -> jax.Array:
         cfg = self.config
         x = x.astype(cfg.dtype)
-        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-                    use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
-                    name="stem_conv")(x)
+        if cfg.stem == "s2d":
+            x = space_to_depth(x, 2)
+            x = nn.Conv(cfg.width, (4, 4), strides=(1, 1),
+                        padding=[(2, 1), (2, 1)], use_bias=False,
+                        dtype=cfg.dtype, param_dtype=jnp.float32,
+                        name="stem_conv_s2d")(x)
+        else:
+            x = nn.Conv(cfg.width, (7, 7), strides=(2, 2),
+                        padding=[(3, 3), (3, 3)], use_bias=False,
+                        dtype=cfg.dtype, param_dtype=jnp.float32,
+                        name="stem_conv")(x)
         x = _norm_factory(cfg, train, update_stats)(name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
@@ -140,6 +158,37 @@ class ResNet(nn.Module):
         x = x.astype(jnp.float32)
         return nn.Dense(cfg.num_classes, name="classifier",
                         param_dtype=jnp.float32)(x)
+
+
+def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
+    """[N, H, W, C] -> [N, H/b, W/b, C*b*b], channel order (bi, bj, c)
+    i.e. out[n, i, j, (bi*b + bj)*C + c] = x[n, i*b + bi, j*b + bj, c].
+    XLA lowers the reshape/transpose pair into the stem conv's input
+    fusion, so the rearrange itself costs no extra HBM round-trip."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, block * block * c)
+
+
+def s2d_stem_kernel(w7: jax.Array, block: int = 2) -> jax.Array:
+    """Exact weight map: 7x7x3xO stride-2 kernel -> the 4x4x12xO
+    stride-1 kernel that computes the SAME function on
+    space_to_depth(x, 2) (the MLPerf-ResNet space-to-depth transform).
+
+    Derivation: out(i) = sum_k W7[k] x[2i + k - 3]. Substitute
+    k' = k + 1 (zero-pad the kernel front to 8): x[2i + k' - 4],
+    then split k' = 2a + b with b in {0, 1}:
+    x[2(i + a - 2) + b] = s2d(x)[i + a - 2, channel (b, c)] — a 4-tap
+    stride-1 conv with padding (2, 1). Same for the second spatial dim.
+    """
+    kh, kw, cin, cout = w7.shape
+    assert (kh, kw) == (7, 7), w7.shape
+    w8 = jnp.pad(w7, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    # [8, 8, C, O] -> [4, bi, 4, bj, C, O] -> [4, 4, (bi, bj, C), O]
+    w4 = w8.reshape(4, block, 4, block, cin, cout)
+    w4 = w4.transpose(0, 2, 1, 3, 4, 5)
+    return w4.reshape(4, 4, block * block * cin, cout)
 
 
 def param_logical_axes(path, value):

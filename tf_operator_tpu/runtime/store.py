@@ -129,6 +129,23 @@ class Store:
                 out.append(obj.deepcopy())
             return out
 
+    def project(self, kind: str, fn, namespace: Optional[str] = None):
+        """Read-only projection under the lock WITHOUT deepcopying:
+        collects ``fn(obj)`` for every object, skipping ``None``
+        results. ``fn`` must treat the object as frozen — no mutation,
+        no retaining references past the call (the cheap-scan pattern
+        of list_claimable, generalized; a full list() deepcopies every
+        payload, which hot per-sync scans must not)."""
+        out = []
+        with self._lock:
+            for (ns, _), obj in self._objects.get(kind, {}).items():
+                if namespace is not None and ns != namespace:
+                    continue
+                v = fn(obj)
+                if v is not None:
+                    out.append(v)
+        return out
+
     def list_claimable(self, kind: str, namespace: str,
                        selector: Dict[str, str],
                        owner_uid: str) -> List[object]:
