@@ -136,7 +136,8 @@ class TestTranslation:
         raw["metadata"]["uid"] = "u-1"
         job = tpujob_from_k8s(raw)
         assert job.spec.replica_specs["worker"].replicas == 3
-        assert job.metadata.resource_version == 41
+        # Opaque string, preserved verbatim (never int-coerced).
+        assert job.metadata.resource_version == "41"
         assert job.metadata.uid == "u-1"
         assert (job.spec.replica_specs["worker"].template.spec
                 .containers[0].image == "tpu-worker:latest")
@@ -505,3 +506,208 @@ class TestKubeScale:
 
     def _pods(self, fake, ns="default"):
         return fake.state.list("pods", ns, "")["items"]
+
+
+# ---------------------------------------------------------------------------
+# Reflector chaos hardening (round-4): watch-resume, 410/compaction,
+# dropped + reordered events, backoff, RV opacity, key-material cleanup,
+# status-clear patches. Reference semantics: client-go
+# tools/cache/reflector.go:166-302 (resume from lastSyncResourceVersion,
+# relist on 410, backoff on failure).
+# ---------------------------------------------------------------------------
+
+from tf_operator_tpu.runtime.kube import (  # noqa: E402
+    KubeInformer,
+    _meta_from_k8s,
+    pod_to_k8s,
+)
+from tf_operator_tpu.runtime.store import Store  # noqa: E402
+
+
+def _mk_pod(name, labels=None):
+    return pod_to_k8s(Pod(metadata=ObjectMeta(name=name,
+                                              labels=dict(labels or {})),
+                          spec=PodSpec(containers=[Container()])))
+
+
+class TestReflectorChaos:
+    @pytest.fixture()
+    def env(self, fake):
+        client = KubeClient(KubeConfig(server=fake.url),
+                            watch_timeout_seconds=1.0)
+        store = Store()
+        inf = KubeInformer(client, store, store_mod.PODS)
+        inf.start()
+        assert inf.synced.wait(5)
+        yield fake, client, store, inf
+        inf.stop()
+
+    def test_watch_resume_without_relist(self, env):
+        """Normal stream expiry (timeoutSeconds) must RESUME from the
+        last delivered RV — not relist: events across several stream
+        generations arrive with exactly ONE list request ever issued."""
+        fake, client, store, inf = env
+        assert fake.state.list_counts.get("pods") == 1
+        client.create(store_mod.PODS, "default", _mk_pod("p1"))
+        wait_for(lambda: store.try_get(store_mod.PODS, "default", "p1"),
+                 msg="p1 mirrored")
+        time.sleep(2.5)  # at least two 1s stream expiries
+        client.create(store_mod.PODS, "default", _mk_pod("p2"))
+        wait_for(lambda: store.try_get(store_mod.PODS, "default", "p2"),
+                 msg="p2 mirrored after stream recycles")
+        assert fake.state.list_counts.get("pods") == 1, \
+            "reflector relisted instead of resuming from last RV"
+
+    def test_mid_stream_410_relists_and_converges(self, env):
+        """An ERROR 410 mid-watch swallows the event it replaced; the
+        reflector must relist (history unknowable) and converge."""
+        fake, client, store, inf = env
+        client.create(store_mod.PODS, "default", _mk_pod("a"))
+        wait_for(lambda: store.try_get(store_mod.PODS, "default", "a"),
+                 msg="a mirrored")
+        before = fake.state.list_counts.get("pods")
+        fake.state.inject_watch_errors = 1
+        client.create(store_mod.PODS, "default", _mk_pod("b"))  # swallowed
+        wait_for(lambda: store.try_get(store_mod.PODS, "default", "b"),
+                 msg="b recovered via relist")
+        assert fake.state.list_counts.get("pods") > before
+
+    def test_non_410_watch_error_backs_off_then_recovers(self, env):
+        """A 500-class watch error takes the failure path (backoff,
+        relist) instead of a hot loop, and the mirror still converges."""
+        fake, client, store, inf = env
+        fake.state.watch_error_code = 500
+        fake.state.inject_watch_errors = 1
+        client.create(store_mod.PODS, "default", _mk_pod("c"))  # swallowed
+        wait_for(lambda: store.try_get(store_mod.PODS, "default", "c"),
+                 timeout=15, msg="c recovered after backoff+relist")
+        # A relist alone must NOT clear the failure counter (a
+        # list-ok/watch-fails loop has to keep escalating); only a
+        # delivered watch event proves the stream healthy again.
+        assert inf._failures >= 1
+        client.create(store_mod.PODS, "default", _mk_pod("c2"))
+        wait_for(lambda: store.try_get(store_mod.PODS, "default", "c2"),
+                 timeout=15, msg="c2 delivered on the recovered stream")
+        assert inf._failures == 0
+
+    def test_compacted_rv_at_watch_start_relists(self, env):
+        """Watch from an RV older than the compaction horizon gets an
+        immediate 410 (etcd compaction): relist, then converge once the
+        RV catches up."""
+        fake, client, store, inf = env
+        with fake.state.lock:
+            fake.state.compact_rv = fake.state._rv + 2
+        client.create(store_mod.PODS, "default", _mk_pod("d1"))
+        client.create(store_mod.PODS, "default", _mk_pod("d2"))
+        wait_for(lambda: store.try_get(store_mod.PODS, "default", "d1")
+                 and store.try_get(store_mod.PODS, "default", "d2"),
+                 timeout=15, msg="mirror converges past compaction")
+
+    def test_dropped_delete_reconciled_by_relist(self, env):
+        """A DELETED event silently lost on the wire leaves a ghost in
+        the cache; the next relist (here forced via 410) must remove it
+        (_on_list's unseen-key sweep)."""
+        fake, client, store, inf = env
+        client.create(store_mod.PODS, "default", _mk_pod("keep"))
+        client.create(store_mod.PODS, "default", _mk_pod("ghost"))
+        wait_for(lambda: store.try_get(store_mod.PODS, "default", "ghost"),
+                 msg="ghost mirrored")
+        fake.state.drop_events = 1
+        client.delete(store_mod.PODS, "default", "ghost")  # event lost
+        time.sleep(0.3)
+        assert store.try_get(store_mod.PODS, "default", "ghost"), \
+            "precondition: the delete event really was dropped"
+        fake.state.inject_watch_errors = 1
+        client.create(store_mod.PODS, "default", _mk_pod("trigger"))
+        wait_for(lambda: store.try_get(store_mod.PODS, "default", "ghost")
+                 is None, msg="ghost swept by relist")
+        assert store.try_get(store_mod.PODS, "default", "keep")
+
+    def test_cross_object_reorder_converges(self, env):
+        """Events of different objects delivered out of order (the only
+        reorder a real apiserver can produce is cross-object) must leave
+        both objects at their correct final state."""
+        fake, client, store, inf = env
+        fake.state.reorder_events = 1
+        client.create(store_mod.PODS, "default", _mk_pod("r1"))  # held
+        client.create(store_mod.PODS, "default", _mk_pod("r2"))  # first
+        wait_for(lambda: store.try_get(store_mod.PODS, "default", "r1")
+                 and store.try_get(store_mod.PODS, "default", "r2"),
+                 msg="both pods mirrored despite reorder")
+
+    def test_backoff_grows_exponentially(self, fake):
+        client = KubeClient(KubeConfig(server=fake.url))
+        inf = KubeInformer(client, Store(), store_mod.PODS)
+        delays = []
+        for n in (1, 2, 3, 6, 50):
+            inf._failures = n
+            delays.append(inf._backoff_seconds())
+        # jittered exponential: each sample in [base/2, base]
+        assert 0.25 <= delays[0] <= 0.5
+        assert 0.5 <= delays[1] <= 1.0
+        assert 1.0 <= delays[2] <= 2.0
+        assert 8.0 <= delays[3] <= 16.0
+        assert delays[4] <= 30.0  # capped
+
+
+class TestAdvisorKubeFixes:
+    def test_resource_version_is_opaque_string(self):
+        meta = _meta_from_k8s({"name": "x", "resourceVersion": "abc-123"})
+        assert meta.resource_version == "abc-123"  # no int coercion to 0
+        meta2 = _meta_from_k8s({"name": "x", "resourceVersion": "999"})
+        assert meta2.resource_version == "999"
+        assert _meta_from_k8s({"name": "x"}).resource_version == 0
+
+    def test_kubeconfig_temp_key_files_cleaned_up(self, tmp_path):
+        """Inline key material materialized to temp files is tracked
+        and deleted by close() (and at interpreter exit), never left
+        behind in the tempdir."""
+        ca = base64.b64encode(b"fake-ca").decode()
+        key = base64.b64encode(b"fake-client-key").decode()
+        cert = base64.b64encode(b"fake-client-cert").decode()
+        cfg_path = tmp_path / "config"
+        cfg_path.write_text(f"""
+apiVersion: v1
+kind: Config
+current-context: test
+contexts:
+  - name: test
+    context: {{cluster: c1, user: u1}}
+clusters:
+  - name: c1
+    cluster:
+      server: https://1.2.3.4:6443
+      certificate-authority-data: {ca}
+users:
+  - name: u1
+    user:
+      client-certificate-data: {cert}
+      client-key-data: {key}
+""")
+        cfg = KubeConfig.from_kubeconfig(str(cfg_path))
+        files = list(cfg.temp_key_files)
+        assert len(files) == 3
+        assert all(os.path.exists(p) for p in files)
+        # 0600: the key file must not be world/group readable.
+        for p in files:
+            assert (os.stat(p).st_mode & 0o077) == 0, oct(os.stat(p).st_mode)
+        cfg.close()
+        assert not any(os.path.exists(p) for p in files)
+        assert cfg.temp_key_files == ()
+
+    def test_status_patch_clears_omitted_fields(self, client, fake,
+                                                operator):
+        """A merge patch can only clear what it names: the controller's
+        status writer must send explicit nulls for unset fields."""
+        client.create(store_mod.TPUJOBS, "default", make_job(name="clr"))
+        # Server-side status with a field the controller will not set.
+        client.patch(store_mod.TPUJOBS, "default", "clr",
+                     {"status": {"completionTime": "2020-01-01T00:00:00Z"}},
+                     subresource="status")
+        job = TPUJob(metadata=ObjectMeta(name="clr", namespace="default"))
+        job.status.start_time = None
+        job.status.completion_time = None
+        operator.controller.update_job_status_in_api(job)
+        raw = client.get(store_mod.TPUJOBS, "default", "clr")
+        assert "completionTime" not in (raw.get("status") or {}), \
+            "omitted field survived the status patch"

@@ -94,8 +94,13 @@ def test_crd_schema_is_structural():
 
     def walk(node, path="root"):
         assert "$ref" not in node, f"$ref at {path}"
-        assert node.get("type") or "x-kubernetes-preserve-unknown-fields" \
-            in node, f"untyped node at {path}"
+        # A node is "typed" with an explicit type, the preserve-unknown
+        # escape hatch, or the native IntOrString marker (all valid
+        # structural-schema forms).
+        assert (node.get("type")
+                or "x-kubernetes-preserve-unknown-fields" in node
+                or node.get("x-kubernetes-int-or-string")), \
+            f"untyped node at {path}"
         assert not ("properties" in node and "additionalProperties" in node), \
             f"properties+additionalProperties at {path}"
         for key, child in (node.get("properties") or {}).items():

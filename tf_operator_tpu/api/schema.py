@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import datetime as _dt
-from typing import Any, Dict, get_args, get_origin
+from typing import Any, Dict, Union, get_args, get_origin
 
 from tf_operator_tpu.api.serde import (
     ApiObject,
@@ -30,7 +30,19 @@ _PRIMITIVES = {
 }
 
 
+def _is_int_or_string(tp: Any) -> bool:
+    """Union[int, str] in either order (e.g. ObjectMeta.resource_version:
+    locally an int, an opaque server string on the kube mirror) — the
+    K8s IntOrString pattern."""
+    if get_origin(tp) is not Union:
+        return False
+    args = set(a for a in get_args(tp) if a is not type(None))
+    return args == {int, str}
+
+
 def _type_schema(tp: Any, defs: Dict[str, dict]) -> dict:
+    if _is_int_or_string(tp):
+        return {"type": ["integer", "string"]}
     tp = _unwrap_optional(tp)
     if tp in _PRIMITIVES:
         return dict(_PRIMITIVES[tp])
@@ -97,6 +109,10 @@ def _structural(tp: Any, depth: int = 0) -> dict:
     if depth > 16:  # cycle guard: no API type recurses, this is a backstop
         return {"type": "object",
                 "x-kubernetes-preserve-unknown-fields": True}
+    if _is_int_or_string(tp):
+        # K8s native IntOrString marker: a `type: object` fallback here
+        # would make the apiserver REJECT the scalar forms.
+        return {"x-kubernetes-int-or-string": True}
     tp = _unwrap_optional(tp)
     if tp in _PRIMITIVES:
         return dict(_PRIMITIVES[tp])

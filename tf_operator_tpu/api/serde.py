@@ -94,14 +94,21 @@ def _wire_keys_for(cls) -> tuple:
 class ApiObject:
     """Base for all API dataclasses; provides wire-format round-tripping."""
 
-    def to_dict(self) -> dict:
+    def to_dict(self, explicit_nulls: bool = False) -> dict:
+        """Wire-format dict. ``explicit_nulls=True`` emits unset/empty
+        TOP-LEVEL fields as JSON ``null`` instead of omitting them —
+        required for RFC 7386 merge-patch writers (a merge patch can
+        only clear a field it names). Nested objects keep omit-empty:
+        nulling recursively would turn every partial update into a
+        destructive replace."""
         out = {}
         for name, wire in _wire_keys_for(type(self)):
             v = getattr(self, name)
-            if v is None:
-                continue
-            # Omit empty containers to keep wire objects tidy (K8s omitempty).
-            if isinstance(v, (dict, list)) and not v:
+            # Omit empty containers to keep wire objects tidy (K8s
+            # omitempty) — unless the caller needs clear-on-patch.
+            if v is None or (isinstance(v, (dict, list)) and not v):
+                if explicit_nulls:
+                    out[wire] = None
                 continue
             out[wire] = _encode(v)
         return out

@@ -25,7 +25,7 @@ from __future__ import annotations
 import dataclasses
 import datetime as _dt
 from dataclasses import field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Union
 
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.serde import ApiObject
@@ -137,7 +137,10 @@ class ObjectMeta(ApiObject):
     annotations: Dict[str, str] = field(default_factory=dict)
     creation_timestamp: Optional[_dt.datetime] = None
     deletion_timestamp: Optional[_dt.datetime] = None
-    resource_version: int = 0
+    # Opaque CAS token (K8s API conventions): compared for equality,
+    # never ordered or parsed. The local Store issues ints; the kube
+    # informer mirror preserves the server's string verbatim.
+    resource_version: Union[int, str] = 0
     owner_references: List[OwnerReference] = field(default_factory=list)
 
     def controller_ref(self) -> Optional[OwnerReference]:

@@ -28,11 +28,13 @@ the API subset the engine needs is small and stable.
 
 from __future__ import annotations
 
+import atexit
 import base64
 import dataclasses
 import json
 import logging
 import os
+import random
 import ssl
 import tempfile
 import threading
@@ -68,6 +70,19 @@ log = logging.getLogger("tpu_operator.kube")
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+# Key-material temp files materialized from inline kubeconfig data;
+# removed at exit so credentials never persist in the tempdir.
+_TEMP_KEY_FILES: list = []
+
+
+@atexit.register
+def _cleanup_temp_key_files() -> None:
+    for path in _TEMP_KEY_FILES:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
 # Restart policies core/v1 Pods accept; the engine maps ExitCode -> Never
 # before the control sees the pod (reference setRestartPolicy,
 # tensorflow/pod.go:319-326), this is the defensive backstop.
@@ -95,6 +110,19 @@ class KubeConfig:
     client_key_file: str = ""
     verify: bool = True
     namespace: str = "default"
+    # Temp files holding key material materialized from inline
+    # kubeconfig *-data fields — deleted by close() and, as a backstop,
+    # at interpreter exit (key material must not outlive the process in
+    # the tempdir).
+    temp_key_files: Tuple[str, ...] = ()
+
+    def close(self) -> None:
+        for path in self.temp_key_files:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        object.__setattr__(self, "temp_key_files", ())
 
     @classmethod
     def in_cluster(cls) -> "KubeConfig":
@@ -142,8 +170,13 @@ class KubeConfig:
         cluster = _by_name("clusters", ctx.get("cluster", "")).get("cluster", {})
         user = _by_name("users", ctx.get("user", "")).get("user", {})
 
+        materialized: list = []
+
         def _materialize(data_key: str, file_key: str, src: dict) -> str:
-            """Inline base64 *-data fields become temp files for ssl."""
+            """Inline base64 *-data fields become temp files for ssl
+            (mkstemp => 0600). Paths are tracked for KubeConfig.close()
+            and deleted at interpreter exit as a backstop — key material
+            must not be left behind in the tempdir."""
             if src.get(file_key):
                 return src[file_key]
             data = src.get(data_key)
@@ -152,6 +185,8 @@ class KubeConfig:
             fd, tmp = tempfile.mkstemp(prefix="kubecfg-", suffix=".pem")
             with os.fdopen(fd, "wb") as f:
                 f.write(base64.b64decode(data))
+            materialized.append(tmp)
+            _TEMP_KEY_FILES.append(tmp)
             return tmp
 
         return cls(
@@ -165,6 +200,7 @@ class KubeConfig:
                                          user),
             verify=not cluster.get("insecure-skip-tls-verify", False),
             namespace=ctx.get("namespace", "default"),
+            temp_key_files=tuple(materialized),
         )
 
     @classmethod
@@ -189,9 +225,14 @@ def _selector_str(selector: Optional[Dict[str, str]]) -> str:
 class KubeClient:
     """Minimal typed REST client over the K8s API (stdlib only)."""
 
-    def __init__(self, config: KubeConfig, timeout: float = 30.0):
+    def __init__(self, config: KubeConfig, timeout: float = 30.0,
+                 watch_timeout_seconds: float = 300.0):
         self.config = config
         self.timeout = timeout
+        # Server-side watch expiry; a stream that outlives it ends
+        # normally and the reflector RESUMES from its last RV (tests
+        # shorten this to exercise the resume path).
+        self.watch_timeout_seconds = watch_timeout_seconds
         self._ssl: Optional[ssl.SSLContext] = None
         if config.server.startswith("https"):
             ctx = ssl.create_default_context(
@@ -315,10 +356,11 @@ class KubeClient:
         params = {"watch": "1",
                   "labelSelector": _selector_str(selector),
                   "allowWatchBookmarks": "true",
-                  "timeoutSeconds": "300",
+                  "timeoutSeconds": str(int(self.watch_timeout_seconds)),
                   "resourceVersion": resource_version}
         resp = self.request("GET", self._path(kind, ns), params=params,
-                            timeout=330.0, stream=True)
+                            timeout=self.watch_timeout_seconds + 30.0,
+                            stream=True)
         if resp_box is not None:
             resp_box.clear()
             resp_box.append(resp)
@@ -349,11 +391,12 @@ def _meta_to_k8s(meta: ObjectMeta) -> dict:
 
 
 def _meta_from_k8s(d: dict) -> ObjectMeta:
-    rv_raw = d.get("resourceVersion", 0)
-    try:
-        rv = int(rv_raw)
-    except (TypeError, ValueError):
-        rv = 0
+    # resourceVersion is contractually an OPAQUE string (K8s API
+    # conventions): preserved verbatim — int coercion would silently
+    # collapse non-numeric RVs to 0 and defeat every CAS that compares
+    # them. The local Store issues its own int RVs; equality checks are
+    # the only comparison either kind ever participates in.
+    rv = str(d.get("resourceVersion", "") or "") or 0
     return ObjectMeta(
         name=d.get("name", ""),
         namespace=d.get("namespace", "default"),
@@ -583,6 +626,11 @@ class KubeEndpointControl(EndpointControl):
 # Informer: cluster state -> Store cache
 # ---------------------------------------------------------------------------
 
+# Reflector failure backoff (client-go reflector backoff analog).
+_BACKOFF_BASE = 0.5
+_BACKOFF_CAP = 30.0
+
+
 class _Reflector:
     """Shared list+watch+reconnect loop (client-go reflector analog):
     relist, stream the watch, relist again on expiry/error, abortable
@@ -621,29 +669,50 @@ class _Reflector:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    def _backoff_seconds(self) -> float:
+        """Exponential backoff with full jitter (client-go's reflector
+        backoff manager semantics: grow to a cap, never hot-loop, add
+        jitter so restarted reflectors don't thundering-herd the API
+        server)."""
+        base = min(_BACKOFF_CAP, _BACKOFF_BASE * (2 ** min(
+            self._failures - 1, 10)))
+        return base * (0.5 + random.random() / 2)
+
     def _run(self) -> None:
+        # Reference behavior (client-go reflector.go:166-302): list once,
+        # then watch; when a watch stream ends normally RESUME watching
+        # from lastSyncResourceVersion instead of relisting (relists are
+        # O(collection) on the server); relist only on 410 Gone (history
+        # compacted past our RV) or after an error.
         first = True
+        rv: Optional[str] = None  # None = must (re)list before watching
         while not self._stop.is_set():
             try:
-                listing = self.client.list(self.kind, self.namespace,
-                                           self.selector)
-                self._on_list(first, listing.get("items") or [])
-                first = False
-                self._failures = 0
-                rv = str((listing.get("metadata") or {})
-                         .get("resourceVersion", "") or "0")
+                if rv is None:
+                    listing = self.client.list(self.kind, self.namespace,
+                                               self.selector)
+                    self._on_list(first, listing.get("items") or [])
+                    first = False
+                    rv = str((listing.get("metadata") or {})
+                             .get("resourceVersion", "") or "0")
                 for etype, raw in self.client.watch(
                         self.kind, self.namespace, self.selector, rv,
                         resp_box=self._resp_box):
                     if self._stop.is_set():
                         return
                     if etype == "BOOKMARK":
+                        # Bookmark's only job: advance the resume point.
+                        brv = str(((raw or {}).get("metadata") or {})
+                                  .get("resourceVersion", "") or "")
+                        if brv:
+                            rv = brv
                         continue
                     if etype == "ERROR":
                         code = int((raw or {}).get("code", 410) or 410)
                         if code == 410:
-                            # Routine watch expiry (410 Gone): relist
-                            # immediately — not a failure, no backoff.
+                            # History compacted past our RV: relist —
+                            # not a failure, no backoff.
+                            rv = None
                             break
                         # Any other server-side watch error takes the
                         # failure path (backoff + escalating log) —
@@ -653,6 +722,20 @@ class _Reflector:
                             "reason", "WatchError"),
                             (raw or {}).get("message", "watch error"))
                     self._on_event(etype, raw)
+                    # Reset ONLY on a delivered event — a successful
+                    # relist must not clear the counter, or a
+                    # list-ok/watch-fails loop oscillates at failures<=1
+                    # forever: backoff never grows and the escalated
+                    # warning at 3 consecutive failures never fires.
+                    self._failures = 0
+                    erv = str(((raw or {}).get("metadata") or {})
+                              .get("resourceVersion", "") or "")
+                    if erv:
+                        rv = erv
+                # Normal stream end (server timeoutSeconds): fall through
+                # with rv intact — the next iteration re-watches from the
+                # last delivered event, losing nothing and listing
+                # nothing.
             except Exception:
                 if self._stop.is_set():
                     return
@@ -664,7 +747,9 @@ class _Reflector:
                          or self._failures % 300 == 0 else log.debug)
                 logfn("reflector %s retrying after %d consecutive "
                       "errors", self.kind, self._failures, exc_info=True)
-                self._stop.wait(1.0)
+                self._stop.wait(self._backoff_seconds())
+                # After an error we cannot know what was missed: relist.
+                rv = None
 
     def _on_list(self, first: bool, items) -> None:
         raise NotImplementedError
@@ -762,11 +847,17 @@ class KubeJobController(TPUJobController):
 
     def update_job_status_in_api(self, job: TPUJob) -> None:
         """Status-subresource merge patch (reference
-        UpdateJobStatusInApiServer, tensorflow/status.go:222-240)."""
+        UpdateJobStatusInApiServer, tensorflow/status.go:222-240).
+
+        Every status field the schema knows is present in the patch —
+        unset ones as explicit JSON nulls — because a merge patch can
+        only CLEAR a field it names (RFC 7386): omitting a field leaves
+        the server's old value in place forever."""
+        body = job.status.to_dict(explicit_nulls=True)
         try:
             self.client.patch(store_mod.TPUJOBS, job.metadata.namespace,
                               job.metadata.name,
-                              {"status": job.status.to_dict()},
+                              {"status": body},
                               subresource="status")
         except store_mod.NotFoundError:
             pass  # job deleted mid-sync

@@ -96,6 +96,27 @@ class FakeKubeState:
         self._watchers: List[Tuple[str, "_q.Queue"]] = []
         # (ns, pod) -> log text, the fake kubelet's log store.
         self.pod_logs: Dict[Tuple[str, str], str] = {}
+        # --- chaos injection (reflector-hardening tests) ---------------
+        # Watches started with resourceVersion < compact_rv get an
+        # immediate ERROR 410 ("too old resource version") — the real
+        # apiserver's etcd-compaction behavior.
+        self.compact_rv = 0
+        # Count of watch ERROR events to inject mid-stream: each watch
+        # delivery decrements it and sends {"code": watch_error_code}
+        # instead of the event (the event itself is NOT delivered — the
+        # client must recover it by relist/resume).
+        self.inject_watch_errors = 0
+        self.watch_error_code = 410
+        # Drop the next N watch events silently (network blip analog:
+        # the client sees nothing and must reconcile via relist).
+        self.drop_events = 0
+        # Reorder pairs: hold back the next event and deliver it AFTER
+        # the one following it, N times.
+        self.reorder_events = 0
+        self._held_event: Optional[Tuple[str, dict]] = None
+        # Per-resource list-request counter (watch-resume assertions:
+        # proves the reflector did NOT relist).
+        self.list_counts: Dict[str, int] = {}
 
     def next_rv(self) -> str:
         self._rv += 1
@@ -389,6 +410,9 @@ class _Handler(BaseHTTPRequestHandler):
                                                       "default", name))
             if query.get("watch") in ("1", "true"):
                 return self._serve_watch(resource, ns, query)
+            with self.state.lock:
+                self.state.list_counts[resource] = \
+                    self.state.list_counts.get(resource, 0) + 1
             return self._send_json(200, self.state.list(
                 resource, ns, query.get("labelSelector", ""),
                 field_selector=query.get("fieldSelector", "")))
@@ -500,6 +524,22 @@ class _Handler(BaseHTTPRequestHandler):
             rv_num = int(rv)
         except ValueError:
             rv_num = 0
+        # Chaos: history compacted past the client's RV -> immediate
+        # 410 ("too old resource version"), the etcd-compaction path a
+        # real apiserver takes. The client must relist.
+        with self.state.lock:
+            compacted = bool(rv_num and rv_num < self.state.compact_rv)
+        if compacted:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            line = json.dumps({"type": "ERROR", "object": {
+                "code": 410, "reason": "Expired",
+                "message": "too old resource version"}})
+            self.wfile.write(line.encode() + b"\n")
+            self.wfile.flush()
+            return
         for item in self.state.list(resource, ns, selector)["items"]:
             try:
                 item_rv = int((item.get("metadata") or {})
@@ -520,6 +560,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Connection", "close")
         self.end_headers()
+        held: Optional[tuple] = None  # chaos: event delayed for reorder
         try:
             while _time.monotonic() < deadline:
                 try:
@@ -533,8 +574,32 @@ class _Handler(BaseHTTPRequestHandler):
                     continue
                 if not _match_selector(meta.get("labels") or {}, selector):
                     continue
+                # Chaos taps (see FakeKubeState.__init__): each applies
+                # to events that WOULD be delivered, so tests control
+                # exactly which update is lost/errored/reordered.
+                with self.state.lock:
+                    if self.state.drop_events > 0:
+                        self.state.drop_events -= 1
+                        continue  # silently lost on the wire
+                    if self.state.inject_watch_errors > 0:
+                        self.state.inject_watch_errors -= 1
+                        code = self.state.watch_error_code
+                        line = json.dumps({"type": "ERROR", "object": {
+                            "code": code, "reason": "Chaos",
+                            "message": "injected watch error"}})
+                        self.wfile.write(line.encode() + b"\n")
+                        self.wfile.flush()
+                        return  # stream dies with the error
+                    if self.state.reorder_events > 0 and held is None:
+                        self.state.reorder_events -= 1
+                        held = (etype, obj)
+                        continue  # delivered after the NEXT event
                 line = json.dumps({"type": etype, "object": obj})
                 self.wfile.write(line.encode() + b"\n")
+                if held is not None:
+                    late = json.dumps({"type": held[0], "object": held[1]})
+                    self.wfile.write(late.encode() + b"\n")
+                    held = None
                 self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
