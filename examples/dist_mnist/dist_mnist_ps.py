@@ -1,0 +1,93 @@
+"""Async parameter-server MNIST worker (reference dist-mnist PS analog).
+
+The reference's examples/v1/dist-mnist/dist_mnist.py trains MNIST with
+TF's between-graph ParameterServerStrategy against operator-scheduled
+`ps` replicas. This is the same topology on this framework's own PS
+runtime (tf_operator_tpu/train/ps.py):
+
+- ps replicas run ``python -m tf_operator_tpu.train.ps --lr 0.2``
+- worker replicas run THIS script: pull params from the sharded
+  servers, compute a local gradient (jax), push it back — fully async,
+  no worker-to-worker synchronization (DownpourSGD).
+
+Run via examples/dist_mnist/tpujob_dist_mnist_ps.yaml or the e2e test
+(tests/test_ps.py::test_e2e_ps_job_trains_async).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=32)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.train.ps import PSClient, cluster_ps_addrs
+
+    addrs = cluster_ps_addrs()
+    if not addrs:
+        raise SystemExit("no ps replicas in TPUJOB_CLUSTER_SPEC")
+    worker_id = int(os.environ.get("TPU_WORKER_ID", "0"))
+
+    # Tiny MLP on synthetic MNIST-shaped data; same seed everywhere so
+    # the racing /init writes are identical.
+    k0 = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(k0)
+    params = {
+        "dense1": {"w": (jax.random.normal(k1, (784, 64)) * 0.05),
+                   "b": jnp.zeros((64,))},
+        "dense2": {"w": (jax.random.normal(k2, (64, 10)) * 0.05),
+                   "b": jnp.zeros((10,))},
+    }
+
+    def loss_fn(p, x, y):
+        h = jax.nn.relu(x @ p["dense1"]["w"] + p["dense1"]["b"])
+        logits = h @ p["dense2"]["w"] + p["dense2"]["b"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    client = PSClient(addrs)
+    client.wait_ready()
+    client.init(jax.tree.map(np.asarray, params))
+
+    losses = []
+    for step in range(args.steps):
+        p = jax.tree.map(jnp.asarray, client.pull())
+        key = jax.random.PRNGKey(worker_id * 10_000 + step)
+        kx, ky = jax.random.split(key)
+        # Synthetic separable data: label = argmax of a fixed random
+        # projection, so the loss genuinely decreases.
+        x = jax.random.normal(kx, (args.batch_size, 784))
+        proj = jax.random.normal(jax.random.PRNGKey(7), (784, 10))
+        y = jnp.argmax(x @ proj, axis=1)
+        loss, grads = grad_fn(p, x, y)
+        client.push(jax.tree.map(np.asarray, grads))
+        losses.append(float(loss))
+        print(f"worker {worker_id} step {step}: loss={losses[-1]:.4f}",
+              flush=True)
+    # Async staleness makes single steps noisy: report window means.
+    k = max(1, min(5, len(losses) // 3))
+    first = sum(losses[:k]) / k
+    last = sum(losses[-k:]) / k
+    print(f"done: first={first:.4f} last={last:.4f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,198 @@
+"""Parameter-server runtime tests (train/ps.py).
+
+Reference analog: the PS role of examples/v1/dist-mnist — scheduled by
+the operator, trained against by workers. Here the runtime itself is
+in-framework, so it gets unit coverage (sharding, async updates, wire
+round-trip) plus a full e2e where 2 ps + 2 worker pods train async
+MNIST through the local backend's cluster-spec loopback resolution.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import optax
+import pytest
+
+from tf_operator_tpu import testutil
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import (
+    Container,
+    JobConditionType,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+    ReplicaSpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.operator import Operator
+from tf_operator_tpu.sdk import TPUJobClient
+from tf_operator_tpu.train.ps import (
+    ParameterServer,
+    PSClient,
+    cluster_ps_addrs,
+    flatten_params,
+    shard_of,
+    unflatten_params,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- unit ----------------------------------------------------------------
+
+def test_flatten_unflatten_round_trip():
+    tree = {"a": {"b": np.ones((2, 3)), "c": np.zeros(4)},
+            "d": np.arange(5)}
+    flat = flatten_params(tree)
+    assert sorted(flat) == ["a/b", "a/c", "d"]
+    back = unflatten_params(flat)
+    np.testing.assert_array_equal(back["a"]["b"], tree["a"]["b"])
+    np.testing.assert_array_equal(back["d"], tree["d"])
+
+
+def test_shard_assignment_stable_and_total():
+    keys = [f"layer{i}/w" for i in range(100)]
+    shards = [shard_of(k, 3) for k in keys]
+    assert set(shards) <= {0, 1, 2}
+    assert len(set(shards)) == 3  # spread, not degenerate
+    assert shards == [shard_of(k, 3) for k in keys]  # stable
+
+
+def test_single_server_applies_exact_sgd_step():
+    server = ParameterServer(optimizer=optax.sgd(0.5)).serve()
+    try:
+        client = PSClient([f"127.0.0.1:{server.port}"])
+        client.wait_ready(timeout=5)
+        params = {"w": np.array([1.0, 2.0], np.float32)}
+        client.init(params)
+        client.push({"w": np.array([0.2, -0.2], np.float32)})
+        out = client.pull()
+        np.testing.assert_allclose(out["w"], [0.9, 2.1], rtol=1e-6)
+    finally:
+        server.stop()
+
+
+def test_init_first_writer_wins():
+    server = ParameterServer().serve()
+    try:
+        client = PSClient([f"127.0.0.1:{server.port}"])
+        client.wait_ready(timeout=5)
+        client.init({"w": np.zeros(2, np.float32)})
+        client.init({"w": np.full(2, 9.0, np.float32)})  # loser
+        np.testing.assert_array_equal(client.pull()["w"], np.zeros(2))
+    finally:
+        server.stop()
+
+
+def test_params_sharded_across_servers():
+    servers = [ParameterServer(optimizer=optax.sgd(1.0)).serve()
+               for _ in range(2)]
+    try:
+        client = PSClient([f"127.0.0.1:{s.port}" for s in servers])
+        client.wait_ready(timeout=5)
+        params = {f"l{i}": {"w": np.full(2, float(i), np.float32)}
+                  for i in range(8)}
+        client.init(params)
+        # Each server holds a strict, non-empty subset.
+        counts = [len(s.pull()[0]) for s in servers]
+        assert all(c > 0 for c in counts) and sum(counts) == 8
+        # Push touches every shard; pull reassembles the full tree.
+        client.push({k: {"w": np.ones(2, np.float32)} for k in params})
+        out = client.pull()
+        for i in range(8):
+            np.testing.assert_allclose(out[f"l{i}"]["w"],
+                                       np.full(2, float(i) - 1.0))
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_wire_format_handles_reserved_and_odd_keys():
+    """'file' collides with np.savez's first parameter; slashes and
+    dots are normal in flax paths — all must round-trip."""
+    from tf_operator_tpu.train.ps import _pack, _unpack
+
+    flat = {"file": np.ones(2), "allow_pickle": np.zeros(3),
+            "a/b.c/d": np.arange(4)}
+    back = _unpack(_pack(flat))
+    assert sorted(back) == sorted(flat)
+    for k in flat:
+        np.testing.assert_array_equal(back[k], flat[k])
+
+
+def test_push_before_init_is_409():
+    server = ParameterServer().serve()
+    try:
+        client = PSClient([f"127.0.0.1:{server.port}"])
+        client.wait_ready(timeout=5)
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            client.push({"w": np.zeros(2, np.float32)})
+        assert e.value.code == 409
+    finally:
+        server.stop()
+
+
+# --- e2e: operator schedules ps + workers, async training converges ------
+
+def test_e2e_ps_job_trains_async(tmp_path):
+    """The reference's dist-mnist PS topology end-to-end: the operator
+    schedules 2 ps + 2 worker pods, the local backend rewrites the
+    cluster spec to loopback, ps pods serve real parameter shards, the
+    workers train async and the job converges to Succeeded (ps pods
+    reaped by CleanPodPolicy like TF parameter servers)."""
+    op = Operator.local(workdir=REPO_ROOT)
+    op.start(threadiness=2)
+    try:
+        client = TPUJobClient(op.store)
+
+        def spec(command, n):
+            return ReplicaSpec(
+                replicas=n,
+                template=PodTemplateSpec(spec=PodSpec(containers=[
+                    Container(name=constants.DEFAULT_CONTAINER_NAME,
+                              command=command,
+                              env={"JAX_PLATFORMS": "cpu"})])))
+
+        job = TPUJob(
+            metadata=ObjectMeta(name="psmnist"),
+            spec=TPUJobSpec(replica_specs={
+                "ps": spec([sys.executable, "-m",
+                            "tf_operator_tpu.train.ps", "--lr", "0.2"], 2),
+                "worker": spec([sys.executable,
+                                "examples/dist_mnist/dist_mnist_ps.py",
+                                "--steps", "30"], 2),
+            }))
+        client.create(job)
+        job = client.wait_for_job("psmnist", timeout=180)
+        assert testutil.check_condition(job, JobConditionType.SUCCEEDED)
+        logs = client.get_job_logs("psmnist")
+        w0 = logs.get("psmnist-worker-0", "")
+        assert "done:" in w0, w0[-500:]
+        first = float(w0.split("first=")[1].split(" ")[0])
+        last = float(w0.split("last=")[1].splitlines()[0])
+        assert last < first, (first, last)
+        # ps pods were reaped on completion (CleanPodPolicy Running).
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            pods = client.get_pods("psmnist")
+            if not any(p.metadata.name.startswith("psmnist-ps-")
+                       and p.status.phase == "Running" for p in pods):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("ps pods still running after success")
+    finally:
+        op.stop()
+
+
+def test_cluster_ps_addrs_parses_spec():
+    spec = ('{"cluster": {"ps": ["127.0.0.1:41000", "127.0.0.1:41001"], '
+            '"worker": ["127.0.0.1:41002"]}, '
+            '"task": {"type": "worker", "index": 0}}')
+    assert cluster_ps_addrs(spec) == ["127.0.0.1:41000", "127.0.0.1:41001"]
+    assert cluster_ps_addrs("") == []

@@ -146,22 +146,17 @@ def validation_warnings(job: TPUJob) -> List[str]:
     (the reference has no warning channel; closest analog is the event
     stream its harness scans). Covers:
 
-    - ``ps`` replicas: API-surface parity only — this framework has no
-      parameter-server runtime (docs/parity.md §2.3), so ps-typed pods
-      run their command with no PS serving behind them;
     - multislice shape mismatch: numSlices > 1 with a worker count that
       is not hosts_per_slice x num_slices leaves slices under- or
       over-subscribed.
+
+    Note: ``ps`` replicas no longer warn — ``tf_operator_tpu.train.ps``
+    is a real parameter-server runtime (sharded async optax updates;
+    docs/parity.md §2.3), so a ps-typed pod running
+    ``python -m tf_operator_tpu.train.ps`` serves its shard for real.
     """
     warnings: List[str] = []
     spec = job.spec
-    ps = spec.replica_specs.get(ReplicaType.PS)
-    if ps is not None and (ps.replicas or 0) > 0:
-        warnings.append(
-            "spec.replicaSpecs[ps]: the parameter-server strategy is "
-            "API-surface parity only — ps pods schedule and run their "
-            "command, but no PS runtime exists (use synchronous data "
-            "parallelism over ICI instead; docs/parity.md §2.3)")
     sl = spec.slice
     if sl.accelerator and sl.num_slices > 1:
         from tf_operator_tpu.bootstrap.topology import parse_accelerator

@@ -112,7 +112,41 @@ class ControlPlaneEnvResolver:
             out["TPU_WORKER_HOSTNAMES"] = ",".join(
                 placement(h)[0]
                 for h in env["TPU_WORKER_HOSTNAMES"].split(","))
+        if env.get("TPUJOB_CLUSTER_SPEC"):
+            out["TPUJOB_CLUSTER_SPEC"] = self._resolve_cluster_spec(
+                env["TPUJOB_CLUSTER_SPEC"], placement)
         return out
+
+    @staticmethod
+    def _resolve_cluster_spec(raw: str, placement) -> str:
+        """Rewrite the ps entries to published placements — the
+        addresses tasks dial through the cluster spec (train/ps.py ps
+        servers bind, workers' PSClient connects). Each claimed pod
+        publishes one free port under the coordinator name
+        (agent claim path); ps pods repurpose it as their serving port,
+        so the same record resolves both sides. Other roles' entries
+        stay DNS-named (identity, not dialed through the spec)."""
+        import json as _json
+
+        try:
+            spec = _json.loads(raw)
+        except ValueError:
+            return raw
+        cluster = spec.get("cluster") or {}
+        if not cluster.get("ps"):
+            return raw
+        resolved = []
+        for entry in cluster["ps"]:
+            hostname = entry.rsplit(":", 1)[0]
+            host, ports = placement(hostname)
+            port = ports.get(COORDINATOR_PORT_NAME)
+            if port is None:
+                raise RuntimeError(
+                    f"ps pod for {hostname} published no port")
+            resolved.append(f"{host}:{port}")
+        cluster["ps"] = resolved
+        spec["cluster"] = cluster
+        return _json.dumps(spec, sort_keys=True)
 
 
 class _LogHandler(BaseHTTPRequestHandler):

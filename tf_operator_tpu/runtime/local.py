@@ -68,6 +68,39 @@ class LoopbackEnvResolver:
     def __init__(self):
         self._lock = threading.Lock()
         self._job_ports: Dict[str, int] = {}  # job uid -> coord port
+        self._host_ports: Dict[str, int] = {}  # cluster DNS name -> port
+
+    def _port_for_host(self, host: str) -> int:
+        """Stable loopback port per cluster DNS name, shared by every
+        pod this backend spawns — the ps replica binds the SAME port
+        its peers dial (single-host kube-dns analog)."""
+        with self._lock:
+            port = self._host_ports.get(host)
+            if port is None:
+                port = _free_port()
+                self._host_ports[host] = port
+            return port
+
+    def _rewrite_cluster_spec(self, raw: str) -> str:
+        """Rewrite ONLY the ps entries: they are the addresses tasks
+        actually dial through the cluster spec (train/ps.py). Other
+        roles' entries stay DNS-named — they are identity, part of the
+        golden bootstrap contract (test_runconfig_golden_full_topology),
+        and their traffic (jax coordinator) is resolved separately."""
+        import json
+
+        try:
+            spec = json.loads(raw)
+        except ValueError:
+            return raw
+        cluster = spec.get("cluster") or {}
+        if cluster.get("ps"):
+            cluster["ps"] = [
+                f"127.0.0.1:{self._port_for_host(h.rsplit(':', 1)[0])}"
+                for h in cluster["ps"]]
+            spec["cluster"] = cluster
+            return json.dumps(spec, sort_keys=True)
+        return raw
 
     def resolve(self, pod: Pod, env: Dict[str, str]) -> Dict[str, str]:
         job_uid = ""
@@ -85,6 +118,10 @@ class LoopbackEnvResolver:
                 out[k] = f"127.0.0.1:{port}"
             elif k == "TPU_WORKER_HOSTNAMES":
                 out[k] = ",".join("127.0.0.1" for _ in v.split(","))
+            elif k == "TPUJOB_CLUSTER_SPEC":
+                # PS/worker tasks dial each other through the cluster
+                # spec; rewrite its DNS names to stable loopback ports.
+                out[k] = self._rewrite_cluster_spec(v)
             else:
                 out[k] = v
         return out

@@ -1,0 +1,360 @@
+"""Minimal parameter-server runtime: the async PS/Worker strategy.
+
+Reference parity: the PS role the reference schedules and whose cluster
+spec TF's ParameterServerStrategy consumes (tensorflow.go:97-139;
+examples/v1/dist-mnist/dist_mnist.py trains against it). The reference
+operator itself ships no PS code — TF does — but a ``ps``-typed replica
+must have a runtime behind it, so this module IS that runtime,
+tpu-operator-native:
+
+- ``python -m tf_operator_tpu.train.ps`` is the ps container command.
+  It reads its own task entry from ``TPUJOB_CLUSTER_SPEC`` (the same
+  env the reference renders), binds that port, and serves its shard of
+  the parameters over HTTP (stdlib only).
+- Parameters are sharded across ps replicas by stable hash of the
+  flattened parameter path (DownpourSGD-style). Each shard holds its
+  optax optimizer state and applies pushed gradients ASYNCHRONOUSLY
+  under a lock — workers never synchronize with each other.
+- Workers use :class:`PSClient`: ``init`` (first writer wins),
+  ``pull`` fresh params, ``push`` gradients.
+
+TPU-native positioning (docs/parity.md §2.3): sync SPMD over a device
+mesh is this framework's first-class strategy; the PS runtime exists
+for parity and for host-side async workloads. It is CPU-oriented by
+design — gradients cross the network per step, so chips would starve.
+
+Wire format: a dict[str, ndarray] as an ``.npz`` payload (stdlib +
+numpy only). Keys are '/'-joined paths into the params pytree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import logging
+import os
+import signal
+import threading
+import urllib.request
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("tpu_operator.ps")
+
+ENV_CLUSTER_SPEC = "TPUJOB_CLUSTER_SPEC"
+
+
+# ---------------------------------------------------------------------------
+# Pytree <-> flat dict[str, ndarray]
+# ---------------------------------------------------------------------------
+
+def flatten_params(tree, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Nested dicts of arrays -> {'a/b/c': ndarray} (flax params shape)."""
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            key = f"{prefix}/{k}" if prefix else str(k)
+            out.update(flatten_params(v, key))
+        return out
+    out[prefix] = np.asarray(tree)
+    return out
+
+
+def unflatten_params(flat: Dict[str, np.ndarray]) -> dict:
+    root: dict = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return root
+
+
+def shard_of(key: str, num_shards: int) -> int:
+    """Stable parameter->shard assignment (crc32: identical on every
+    worker and server, unlike Python's salted hash())."""
+    return zlib.crc32(key.encode()) % max(1, num_shards)
+
+
+def _pack(flat: Dict[str, np.ndarray]) -> bytes:
+    """Positional array names + a key manifest: passing user-controlled
+    keys to np.savez as kwargs would collide with its own parameters
+    (a param path named 'file' raises TypeError) and break on
+    non-identifier characters."""
+    keys = sorted(flat)
+    buf = io.BytesIO()
+    np.savez(buf, __keys__=np.array(keys),
+             **{f"a{i}": np.asarray(flat[k]) for i, k in enumerate(keys)})
+    return buf.getvalue()
+
+
+def _unpack(data: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(data)) as z:
+        keys = [str(k) for k in z["__keys__"]]
+        return {k: z[f"a{i}"] for i, k in enumerate(keys)}
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+class ParameterServer:
+    """One shard: holds its parameters + optax state, applies pushed
+    gradients asynchronously (first-come order, under a lock)."""
+
+    def __init__(self, optimizer=None, host: str = "", port: int = 0):
+        import optax
+
+        self.optimizer = optimizer or optax.sgd(0.01)
+        self._lock = threading.Lock()
+        self._params: Optional[Dict[str, np.ndarray]] = None
+        self._opt_state = None
+        self._version = 0
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._host, self._port = host, port
+
+    # -- state ops (thread-safe) ---------------------------------------
+
+    def init(self, flat: Dict[str, np.ndarray]) -> bool:
+        """First writer wins (workers race to initialize); returns
+        whether THIS call installed the parameters."""
+        with self._lock:
+            if self._params is not None:
+                return False
+            self._params = {k: np.asarray(v) for k, v in flat.items()}
+            self._opt_state = self.optimizer.init(self._params)
+            return True
+
+    def pull(self) -> Tuple[Dict[str, np.ndarray], int]:
+        with self._lock:
+            if self._params is None:
+                raise KeyError("parameters not initialized")
+            return dict(self._params), self._version
+
+    def push(self, grads: Dict[str, np.ndarray]) -> int:
+        """Apply one async gradient update; returns the new version."""
+        with self._lock:
+            if self._params is None:
+                raise KeyError("parameters not initialized")
+            aligned = {k: np.asarray(grads[k]) for k in self._params
+                       if k in grads}
+            if len(aligned) != len(self._params):
+                missing = set(self._params) - set(aligned)
+                raise ValueError(f"push missing keys: {sorted(missing)[:3]}")
+            updates, self._opt_state = self.optimizer.update(
+                aligned, self._opt_state, self._params)
+            import optax
+
+            self._params = optax.apply_updates(self._params, updates)
+            self._params = {k: np.asarray(v)
+                            for k, v in self._params.items()}
+            self._version += 1
+            return self._version
+
+    # -- HTTP ----------------------------------------------------------
+
+    def serve(self) -> "ParameterServer":
+        ps = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                log.debug("ps http: " + fmt, *args)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", "0"))
+                return self.rfile.read(n)
+
+            def _send(self, code: int, data: bytes = b"",
+                      ctype: str = "application/octet-stream"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._send(200, b"ok", "text/plain")
+                if self.path == "/params":
+                    try:
+                        flat, version = ps.pull()
+                    except KeyError:
+                        return self._send(409, b"uninitialized",
+                                          "text/plain")
+                    data = _pack(flat)
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.send_header("X-PS-Version", str(version))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                self._send(404, b"not found", "text/plain")
+
+            def do_POST(self):
+                if self.path == "/init":
+                    installed = ps.init(_unpack(self._body()))
+                    return self._send(200 if installed else 208,
+                                      b"ok", "text/plain")
+                if self.path == "/push":
+                    try:
+                        version = ps.push(_unpack(self._body()))
+                    except KeyError:
+                        return self._send(409, b"uninitialized",
+                                          "text/plain")
+                    except ValueError as e:
+                        return self._send(400, str(e).encode(),
+                                          "text/plain")
+                    return self._send(200, str(version).encode(),
+                                      "text/plain")
+                self._send(404, b"not found", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((self._host or "", self._port),
+                                          Handler)
+        self._port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="ps-http", daemon=True).start()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Worker-side client
+# ---------------------------------------------------------------------------
+
+class PSClient:
+    """Worker handle on the sharded parameter servers."""
+
+    def __init__(self, addrs: List[str], timeout: float = 30.0):
+        if not addrs:
+            raise ValueError("no parameter-server addresses")
+        self.addrs = list(addrs)
+        self.timeout = timeout
+
+    def _req(self, addr: str, path: str, data: Optional[bytes] = None):
+        req = urllib.request.Request(
+            f"http://{addr}{path}", data=data,
+            method="POST" if data is not None else "GET")
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    def _partition(self, flat: Dict[str, np.ndarray]
+                   ) -> List[Dict[str, np.ndarray]]:
+        parts: List[Dict[str, np.ndarray]] = [
+            {} for _ in range(len(self.addrs))]
+        for k, v in flat.items():
+            parts[shard_of(k, len(self.addrs))][k] = np.asarray(v)
+        return parts
+
+    def init(self, params) -> None:
+        """Race-safe global init: every shard keeps its first writer."""
+        for addr, part in zip(self.addrs, self._partition(
+                flatten_params(params))):
+            self._req(addr, "/init", _pack(part)).read()
+
+    def pull(self) -> dict:
+        flat: Dict[str, np.ndarray] = {}
+        for addr in self.addrs:
+            with self._req(addr, "/params") as resp:
+                flat.update(_unpack(resp.read()))
+        return unflatten_params(flat)
+
+    def push(self, grads) -> None:
+        for addr, part in zip(self.addrs,
+                              self._partition(flatten_params(grads))):
+            if part:
+                self._req(addr, "/push", _pack(part)).read()
+
+    def wait_ready(self, timeout: float = 60.0) -> None:
+        import time
+
+        deadline = time.monotonic() + timeout
+        for addr in self.addrs:
+            while True:
+                try:
+                    with self._req(addr, "/healthz") as resp:
+                        if resp.status == 200:
+                            break
+                except OSError:
+                    pass
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(f"ps {addr} never became ready")
+                time.sleep(0.1)
+
+
+# ---------------------------------------------------------------------------
+# Cluster-spec plumbing + process entrypoint
+# ---------------------------------------------------------------------------
+
+def cluster_ps_addrs(spec_json: Optional[str] = None) -> List[str]:
+    """ps 'host:port' list from TPUJOB_CLUSTER_SPEC (operator-injected;
+    the local backend's resolver rewrites hosts to reachable ones)."""
+    raw = spec_json if spec_json is not None else os.environ.get(
+        ENV_CLUSTER_SPEC, "")
+    if not raw:
+        return []
+    return list((json.loads(raw).get("cluster") or {}).get("ps") or [])
+
+
+def own_task(spec_json: Optional[str] = None) -> Tuple[str, int]:
+    raw = spec_json if spec_json is not None else os.environ.get(
+        ENV_CLUSTER_SPEC, "")
+    task = (json.loads(raw).get("task") or {}) if raw else {}
+    return task.get("type", ""), int(task.get("index", 0))
+
+
+def main(argv=None) -> int:
+    """The ps container command: serve this task's parameter shard
+    until terminated (job completion reaps ps pods via CleanPodPolicy,
+    exactly like TF parameter servers under the reference)."""
+    import optax
+
+    ap = argparse.ArgumentParser(prog="tpu-operator-ps")
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--momentum", type=float, default=0.0)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    ttype, index = own_task()
+    if ttype != "ps":
+        raise SystemExit(f"task type is {ttype!r}, not 'ps' "
+                         f"(is {ENV_CLUSTER_SPEC} set?)")
+    addrs = cluster_ps_addrs()
+    own = addrs[index] if index < len(addrs) else ":0"
+    host, _, port_s = own.rpartition(":")
+    port = int(port_s or 0)
+    # Bind loopback when that's where peers dial (single-host resolver):
+    # an INADDR_ANY bind would expose the unauthenticated param API to
+    # the network. Non-loopback entries (kube pod DNS) need
+    # all-interfaces binding, standard for in-cluster servers.
+    bind_host = "127.0.0.1" if host.startswith("127.") else ""
+    opt = (optax.sgd(args.lr, momentum=args.momentum)
+           if args.momentum else optax.sgd(args.lr))
+    server = ParameterServer(optimizer=opt, host=bind_host,
+                             port=port).serve()
+    log.info("parameter server shard %d serving on :%d", index,
+             server.port)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
