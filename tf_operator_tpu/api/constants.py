@@ -51,5 +51,13 @@ JOB_ROLE_MASTER = "master"
 # Volcano's scheduling.k8s.io/group-name + volcano.sh/task-spec).
 ANNOTATION_GANG_GROUP = "scheduling.tpu-operator.dev/group-name"
 ANNOTATION_GANG_TASK = "scheduling.tpu-operator.dev/task-spec"
+# Digest of the bootstrap env rendered into the pod at creation. When a
+# live pod's digest no longer matches the job's current topology (e.g.
+# an elastic resize changed the dense cluster spec / world size), the
+# engine restarts it so every process rejoins the new world from the
+# latest checkpoint. Sparse-elastic workers' env doesn't embed peers,
+# so resizes leave them running (reference enableDynamicWorker
+# semantics, tensorflow.go:64-83).
+ANNOTATION_BOOTSTRAP_HASH = "tpu-operator.dev/bootstrap-hash"
 
 DEFAULT_GANG_SCHEDULER = "slice-gang"

@@ -99,6 +99,14 @@ class JobPlugin(abc.ABC):
         """Inject distributed-bootstrap env into the pod (reference
         SetClusterSpec -> TF_CONFIG; here -> jax.distributed env)."""
 
+    def bootstrap_hash(self, job: TPUJob, rtype: str, index: int) -> str:
+        """Digest of the bootstrap env set_cluster_spec would render for
+        (rtype, index) NOW. Stamped on pods at creation and compared on
+        every sync: a mismatch means the world this pod joined no
+        longer exists (elastic resize) and it must restart into the new
+        one. '' disables the check (plugins without bootstrap env)."""
+        return ""
+
     def is_master_role(self, replica_specs: Dict[str, ReplicaSpec],
                        rtype: str, index: int) -> bool:
         """Reference tensorflow/controller.go:418-425: chief/master pods,
@@ -298,6 +306,10 @@ class JobEngine:
         # Reset tallies for this type (reference status.go:243-249).
         job.status.replica_statuses[rt] = ReplicaStatus()
 
+        # World digest for this rtype, computed lazily ONCE per sync
+        # (bootstrap_hash is index-invariant by contract).
+        want_hash: Optional[str] = None
+
         for index, pod_slice in enumerate(self.get_pod_slices(pods, num_replicas)):
             if len(pod_slice) > 1:
                 log.warning("too many pods for %s %s index %d", job.key(), rt,
@@ -311,6 +323,34 @@ class JobEngine:
                     # Scale-down: out-of-range index (reference pod.go:121-127).
                     self._delete_pod(job, pod, rt)
                     continue
+
+                # Elastic world restart: a live pod whose stamped
+                # bootstrap digest no longer matches the job's current
+                # topology is running in a world that no longer exists
+                # (resize changed the dense cluster spec). Restart it —
+                # the recreated pod rejoins the new world and resumes
+                # from the latest checkpoint. Sparse-elastic workers'
+                # digests don't change on resize, so they keep running
+                # (reference enableDynamicWorker, tensorflow.go:64-83).
+                have = pod.metadata.annotations.get(
+                    constants.ANNOTATION_BOOTSTRAP_HASH, "")
+                if have and pod.status.phase not in (PodPhase.SUCCEEDED,
+                                                     PodPhase.FAILED):
+                    if want_hash is None:
+                        want_hash = self.plugin.bootstrap_hash(job, rt,
+                                                               index)
+                    want = want_hash
+                    if want and want != have:
+                        self.recorder.event(
+                            job, EVENT_TYPE_NORMAL, "WorldResized",
+                            f"Pod {pod.metadata.name} restarting: "
+                            "cluster topology changed "
+                            "(elastic resize); will rejoin the new "
+                            "world from the latest checkpoint")
+                        self._delete_pod(job, pod, rt)
+                        metrics.restarted_pods.inc(
+                            job_namespace=job.metadata.namespace)
+                        continue
 
                 exit_code = self._container_exit_code(pod)
                 if exit_code not in (None, 0):
@@ -372,6 +412,10 @@ class JobEngine:
 
         # Cluster bootstrap env (reference SetClusterSpec, pod.go:205).
         self.plugin.set_cluster_spec(job, pod, rt, index)
+        digest = self.plugin.bootstrap_hash(job, rt, index)
+        if digest:
+            pod.metadata.annotations[
+                constants.ANNOTATION_BOOTSTRAP_HASH] = digest
 
         # ExitCode policy is operator-level; the backend must not restart
         # the process itself (reference setRestartPolicy, pod.go:319-326).

@@ -18,6 +18,7 @@ from tf_operator_tpu.api.types import (
     Endpoint,
     Pod,
     ReplicaSpec,
+    ReplicaType,
     TPUJob,
     JobConditionType,
 )
@@ -465,3 +466,41 @@ class TPUJobController(JobPlugin):
         # User-provided env wins over injected env? No: bootstrap identity
         # env must be authoritative (reference overwrites TF_CONFIG).
         container.env.update(env)
+
+    def bootstrap_hash(self, job: TPUJob, rtype: str, index: int) -> str:
+        """sha1 over the WORLD a pod of this rtype joins — deliberately
+        index-invariant (every per-index env key is a pure function of
+        (world, index), so for a fixed pod name the env changes iff the
+        world does; the engine computes one digest per rtype per sync).
+
+        Per-index keys are dropped rather than hashed; the world facts
+        they derive from (replica lists, topology) are all present in
+        the remaining keys. Sparse-elastic workers additionally drop
+        the world-coupled keys their async runtime never joins
+        (their own sparse cluster entry and the dense jax world size),
+        so a worker resize leaves them running — the reference
+        enableDynamicWorker no-restart semantics (tensorflow.go:64-83);
+        a ps resize still changes their digest (they dial ps)."""
+        import hashlib
+        import json as _json
+
+        del index  # world digest: see docstring
+        rt = rtype.lower()
+        env = render_worker_env(job, rtype, 0)
+        for k in ("JAX_PROCESS_ID", "TPU_WORKER_ID",
+                  "TPU_WORKER_HOSTNAMES", "MEGASCALE_SLICE_ID",
+                  "MEGASCALE_SLICE_COORDINATOR"):
+            env.pop(k, None)
+        sparse = (job.spec.enable_elastic_worker
+                  and rt == ReplicaType.WORKER)
+        raw = env.get("TPUJOB_CLUSTER_SPEC")
+        if raw:
+            d = _json.loads(raw)
+            d.pop("task", None)
+            if sparse:
+                (d.get("cluster") or {}).pop(ReplicaType.WORKER, None)
+            env["TPUJOB_CLUSTER_SPEC"] = _json.dumps(d, sort_keys=True)
+        if sparse:
+            env.pop("JAX_NUM_PROCESSES", None)
+        blob = "\x00".join(f"{k}={env[k]}" for k in sorted(env))
+        return hashlib.sha1(blob.encode()).hexdigest()

@@ -59,8 +59,13 @@ def main(argv=None) -> int:
         os.makedirs(stub_dir, exist_ok=True)
         snapshot = {k: os.environ[k] for k in ENV_KEYS if k in os.environ}
         snapshot["argv"] = sys.argv[1:]
-        with open(os.path.join(stub_dir, f"{pod_name}.env.json"), "w") as f:
+        # Atomic publish: tests poll for this file and read it the
+        # moment it exists; a plain open-write would expose a partial
+        # JSON document to that race.
+        snap_path = os.path.join(stub_dir, f"{pod_name}.env.json")
+        with open(snap_path + ".tmp", "w") as f:
             json.dump(snapshot, f, indent=2, sort_keys=True)
+        os.replace(snap_path + ".tmp", snap_path)
         cmd_path = os.path.join(stub_dir, f"{pod_name}.cmd")
 
     deadline = (time.monotonic() + args.exit_after
