@@ -107,6 +107,26 @@ def run_cpu_mesh():
                 except Exception:
                     return -1
 
+            from tf_operator_tpu.parallel.pipeline import (
+                compiled_peak_bytes,
+                select_schedule,
+            )
+
+            # Peak metric: the SAME formula the trainer's auto probe
+            # uses (compiled_peak_bytes) — these columns must describe
+            # what schedule="auto" actually picks.
+            pg = compiled_peak_bytes(lowered_g)
+            pf = compiled_peak_bytes(lowered)
+            chosen_ample = select_schedule(pg, 1 << 40)
+            # A budget between the two footprints is the memory-bound
+            # regime 1F1B exists for — only meaningful when GPipe's
+            # peak actually exceeds 1F1B's (at tiny m the 2pp-slot ring
+            # can out-size GPipe's stash).
+            if pg is not None and pf is not None and pg > pf:
+                chosen_tight = select_schedule(pg, (pf + pg) // 2)
+            else:
+                chosen_tight = "n/a"
+            times = {"gpipe": t_gpipe, "1f1b": t_1f1b}
             rows.append({
                 "pp": pp, "m": m,
                 "t_1f1b_ms": round(t_1f1b * 1e3, 2),
@@ -115,6 +135,13 @@ def run_cpu_mesh():
                 "model_ticks_gpipe_fwd": m + pp - 1,
                 "temp_mb_1f1b": round(temp_bytes(lowered) / 2**20, 1),
                 "temp_mb_gpipe": round(temp_bytes(lowered_g) / 2**20, 1),
+                "auto_choice": chosen_ample,
+                # The verdict's bar: the chosen schedule is never the
+                # slower of the two that FIT. Under the tight budget
+                # only 1F1B fits, so it is vacuously optimal there.
+                "auto_is_fastest": (times[chosen_ample]
+                                    <= min(times.values()) + 1e-9),
+                "auto_choice_tight_budget": chosen_tight,
             })
         for r in rows:
             print(json.dumps(r), flush=True)

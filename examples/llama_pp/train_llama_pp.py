@@ -30,6 +30,10 @@ def main() -> int:
     ap.add_argument("--seq-len", type=int, default=32)
     ap.add_argument("--microbatches", type=int, default=4)
     ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--schedule", default="auto",
+                    choices=("auto", "gpipe", "1f1b"),
+                    help="pipeline schedule; auto keeps GPipe when its "
+                         "activation stash fits device memory, else 1F1B")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force N virtual CPU devices (0 = use whatever "
                          "jax.devices() offers)")
@@ -68,19 +72,22 @@ def main() -> int:
     print("mesh:", dict(mesh.shape))
 
     trainer = LlamaPipelineTrainer(cfg, mesh, optax.adamw(3e-3),
-                                   num_microbatches=args.microbatches)
+                                   num_microbatches=args.microbatches,
+                                   schedule=args.schedule)
     rng = jax.random.PRNGKey(0)
     data_rng = np.random.default_rng(0)
     sample = jnp.zeros((args.batch_size, args.seq_len + 1), jnp.int32)
     state, shardings = trainer.init(rng, sample[:, :-1])
-    step = trainer.make_train_step(shardings)
+    step = trainer.make_train_step(shardings, sample_tokens=sample)
+    print(f"schedule: requested={args.schedule} "
+          f"resolved={trainer.resolved_schedule}")
     for i in range(args.steps):
         tokens = jnp.asarray(data_rng.integers(
             0, cfg.vocab_size, (args.batch_size, args.seq_len + 1)),
             jnp.int32)
         state, metrics = step(state, tokens)
         print(f"step {i}: loss={float(metrics['loss']):.4f}")
-    print("llama 1F1B pipeline training OK")
+    print(f"llama {trainer.resolved_schedule} pipeline training OK")
     return 0
 
 

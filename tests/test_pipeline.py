@@ -433,3 +433,113 @@ def test_llama_pipeline_trainer_checkpoint_resume(tmp_path):
     _, mb2 = step2(state_b, tokens)
     assert abs(float(ma2["loss"]) - float(mb2["loss"])) < 1e-5
     ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# Round-4: GPipe full-LM composition + schedule auto-selection
+# ---------------------------------------------------------------------------
+
+def test_pipeline_lm_gpipe_matches_1f1b_and_serial():
+    """The GPipe full-LM path computes the SAME loss and gradients as
+    the 1F1B path and serial autodiff — schedules are pure execution
+    strategies, never semantics."""
+    from tf_operator_tpu.parallel.pipeline import (
+        pipeline_lm_train_gpipe,
+        pipeline_lm_train_sharded,
+    )
+
+    V, PP = 32, 4
+    mesh = make_mesh(MeshConfig(dp=2, pp=PP))
+    stacked = stack_stage_params(make_params(PP, seed=41))
+    rng = jax.random.PRNGKey(42)
+    embed = {"table": jax.random.normal(rng, (V, HID)) * 0.5}
+    head = {"w": jax.random.normal(jax.random.fold_in(rng, 1),
+                                   (HID, V)) * 0.5}
+    tokens = jax.random.randint(jax.random.fold_in(rng, 2), (16,), 0, V)
+    labels = jax.random.randint(jax.random.fold_in(rng, 3), (16,), 0, V)
+
+    def embed_fn(ep, tok):
+        return ep["table"][tok]
+
+    def loss_fn(y, t, hp):
+        logits = y @ hp["w"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, t[..., None], axis=-1).mean()
+
+    args = (stage_fn, loss_fn, embed_fn, stacked, embed, head,
+            tokens, labels, mesh)
+    l_g, s_g, e_g, h_g = pipeline_lm_train_gpipe(*args,
+                                                 num_microbatches=4)
+    l_f, s_f, e_f, h_f = pipeline_lm_train_sharded(*args,
+                                                   num_microbatches=4)
+    np.testing.assert_allclose(float(l_g), float(l_f), atol=1e-5,
+                               rtol=1e-5)
+    for got, want in ((s_g, s_f), (e_g, e_f), (h_g, h_f)):
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(want), strict=True):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+
+def test_select_schedule_policy():
+    from tf_operator_tpu.parallel.pipeline import select_schedule
+
+    assert select_schedule(10**6, None) == "gpipe"      # unbounded budget
+    assert select_schedule(10**6, 10**9) == "gpipe"     # fits
+    assert select_schedule(10**9, 10**6) == "1f1b"      # memory-bound
+    # The safety margin: just-barely-at-budget is NOT a fit.
+    assert select_schedule(10**6, 10**6) == "1f1b"
+    # Fail SAFE: a real budget with an unknown footprint must not
+    # gamble on the memory-hungry schedule.
+    assert select_schedule(None, 10**9) == "1f1b"
+    assert select_schedule(None, None) == "gpipe"
+
+
+def test_llama_pipeline_trainer_schedule_auto_and_forced():
+    """Auto keeps GPipe under an ample budget and falls back to 1F1B
+    under a tight one; both schedules train the same model, and the
+    choice is observable (resolved_schedule)."""
+    import dataclasses
+
+    import optax
+
+    from tf_operator_tpu.models.llama import llama_tiny
+    from tf_operator_tpu.parallel.llama_pp import LlamaPipelineTrainer
+
+    cfg = dataclasses.replace(
+        llama_tiny(vocab_size=64, max_seq_len=32), n_layers=4,
+        dtype=jnp.float32, attention_impl="xla")
+    mesh = make_mesh(MeshConfig(dp=2, pp=4))
+    rng = jax.random.PRNGKey(71)
+    tokens = jax.random.randint(jax.random.fold_in(rng, 1), (8, 17), 0,
+                                cfg.vocab_size)
+
+    # Ample budget -> GPipe (the measured-faster schedule).
+    tr = LlamaPipelineTrainer(cfg, mesh, optax.adam(3e-3),
+                              num_microbatches=4,
+                              memory_budget_bytes=1 << 40)
+    state, sh = tr.init(rng, tokens[:, :-1])
+    step = tr.make_train_step(sh, sample_tokens=tokens)
+    assert tr.resolved_schedule == "gpipe"
+    losses = []
+    for _ in range(6):
+        state, m = step(state, tokens)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+
+    # Tight budget -> 1F1B (the O(pp)-memory escape hatch).
+    tr2 = LlamaPipelineTrainer(cfg, mesh, optax.adam(3e-3),
+                               num_microbatches=4,
+                               memory_budget_bytes=1)
+    state2, sh2 = tr2.init(jax.random.PRNGKey(72), tokens[:, :-1])
+    step2 = tr2.make_train_step(sh2, sample_tokens=tokens)
+    assert tr2.resolved_schedule == "1f1b"
+    state2, m2 = step2(state2, tokens)
+    assert np.isfinite(float(m2["loss"]))
+
+    # Forced schedules are respected verbatim.
+    tr3 = LlamaPipelineTrainer(cfg, mesh, optax.adam(3e-3),
+                               num_microbatches=4, schedule="1f1b")
+    _, sh3 = tr3.init(jax.random.PRNGKey(73), tokens[:, :-1])
+    tr3.make_train_step(sh3)
+    assert tr3.resolved_schedule == "1f1b"

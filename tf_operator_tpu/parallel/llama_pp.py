@@ -15,7 +15,7 @@ remaining axes, exact gradients for every parameter group.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -53,7 +53,8 @@ def llama_pp_loss_and_grads(cfg: LlamaConfig, params: Dict[str, Any],
                             tokens: jax.Array, mesh,
                             num_microbatches: int,
                             axis_name: str = "pp",
-                            staged: bool = False
+                            staged: bool = False,
+                            schedule: str = "1f1b"
                             ) -> Tuple[jax.Array, Dict[str, Any]]:
     """One pipeline-parallel LM loss+grad evaluation.
 
@@ -101,7 +102,15 @@ def llama_pp_loss_and_grads(cfg: LlamaConfig, params: Dict[str, Any],
                   @ hp["lm_head"]["kernel"].astype(cfg.dtype))
         return cross_entropy_loss(logits, t_mb)
 
-    loss, sgrads, egrads, hgrads = pipeline_lm_train_sharded(
+    if schedule == "gpipe":
+        from tf_operator_tpu.parallel.pipeline import pipeline_lm_train_gpipe
+
+        train = pipeline_lm_train_gpipe
+    elif schedule == "1f1b":
+        train = pipeline_lm_train_sharded
+    else:
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    loss, sgrads, egrads, hgrads = train(
         stage_fn, loss_fn, embed_fn, stacked, embed_params, head_params,
         inputs, targets, mesh, num_microbatches, axis_name=axis_name)
     grads = {
@@ -131,13 +140,30 @@ class LlamaPipelineTrainer:
     dicts (the pipeline owns its own input split)."""
 
     def __init__(self, cfg: LlamaConfig, mesh, optimizer,
-                 num_microbatches: int, axis_name: str = "pp"):
+                 num_microbatches: int, axis_name: str = "pp",
+                 schedule: str = "auto",
+                 memory_budget_bytes: Optional[int] = None):
+        """``schedule``: "gpipe", "1f1b", or "auto" (default). Auto
+        compiles the GPipe step, reads XLA's memory analysis, and keeps
+        GPipe iff its O(m) activation stash fits ``memory_budget_bytes``
+        (default: the device's reported memory limit; unbounded when
+        the platform reports none, e.g. CPU) — measured, GPipe is never
+        slower when it fits (docs/benchmarks.md pipeline table), so
+        1F1B is exactly the memory-ceiling escape hatch its O(pp) ring
+        exists for. The resolved choice lands in
+        ``self.resolved_schedule`` after make_train_step."""
+        if schedule not in ("auto", "gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
         self.cfg = cfg
         self.mesh = mesh
         self.optimizer = optimizer
         self.num_microbatches = num_microbatches
         self.axis_name = axis_name
         self.pp = mesh.shape[axis_name]
+        self.schedule = schedule
+        self.memory_budget_bytes = memory_budget_bytes
+        self.resolved_schedule: Optional[str] = (
+            schedule if schedule != "auto" else None)
 
     def _placement(self, tree):
         """Path-based placement (the robust rule the GSPMD trainer uses
@@ -209,7 +235,7 @@ class LlamaPipelineTrainer:
         return abstract_state_with_shardings(
             self._init_fn(sample_tokens), shardings, rng)
 
-    def make_train_step(self, state_shardings):
+    def _build_step(self, state_shardings, schedule: str):
         cfg, mesh, m = self.cfg, self.mesh, self.num_microbatches
         axis, opt = self.axis_name, self.optimizer
 
@@ -222,7 +248,8 @@ class LlamaPipelineTrainer:
             loss, grads = llama_pp_loss_and_grads(cfg, state.params,
                                                   tokens, mesh, m,
                                                   axis_name=axis,
-                                                  staged=True)
+                                                  staged=True,
+                                                  schedule=schedule)
             updates, opt_state = opt.update(grads, state.opt_state,
                                             state.params)
             params = optax.apply_updates(state.params, updates)
@@ -231,3 +258,59 @@ class LlamaPipelineTrainer:
             return new_state, {"loss": loss}
 
         return step
+
+    def _device_memory_budget(self) -> Optional[int]:
+        if self.memory_budget_bytes is not None:
+            return self.memory_budget_bytes
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            limit = stats.get("bytes_limit")
+            return int(limit) if limit else None
+        except Exception:
+            return None  # platform reports nothing (CPU): unbounded
+
+    def _compile_probe(self, step, state_shardings, sample_tokens):
+        """AOT-compile ``step`` for the probe shapes; returns (compiled
+        executable or None, peak bytes or None). The executable is
+        REUSED as the returned train step so the (usually minutes-long)
+        pipeline compile is paid once, not once for the probe and again
+        on the first real call."""
+        from tf_operator_tpu.parallel.pipeline import compiled_peak_bytes
+
+        try:
+            abstract = self.abstract_state(jax.random.PRNGKey(0),
+                                           sample_tokens,
+                                           state_shardings)
+            tok = jax.ShapeDtypeStruct(sample_tokens.shape,
+                                       sample_tokens.dtype)
+            compiled = step.lower(abstract, tok).compile()
+        except Exception:
+            return None, None
+        return compiled, compiled_peak_bytes(compiled)
+
+    def make_train_step(self, state_shardings, sample_tokens=None):
+        """Compiled (state, tokens) -> (state, metrics) step.
+
+        ``schedule="auto"`` needs ``sample_tokens`` (shape/dtype of the
+        step's token batch) to size the GPipe memory probe. Without it
+        — or when the probe fails — selection FAILS SAFE: GPipe only on
+        platforms reporting no memory limit (CPU), 1F1B whenever a real
+        budget exists but the footprint is unknown (a model that
+        trained under 1F1B must never OOM from a silent default flip)."""
+        from tf_operator_tpu.parallel.pipeline import select_schedule
+
+        chosen = self.schedule
+        if chosen == "auto":
+            budget = self._device_memory_budget()
+            compiled = None
+            peak = None
+            if sample_tokens is not None and budget is not None:
+                gpipe_step = self._build_step(state_shardings, "gpipe")
+                compiled, peak = self._compile_probe(
+                    gpipe_step, state_shardings, sample_tokens)
+            chosen = select_schedule(peak, budget)
+            if chosen == "gpipe" and compiled is not None:
+                self.resolved_schedule = chosen
+                return compiled  # reuse the probe's executable
+        self.resolved_schedule = chosen
+        return self._build_step(state_shardings, chosen)

@@ -40,7 +40,7 @@ How it maps to hardware:
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -344,6 +344,78 @@ def pipeline_train_sharded(stage_fn: StageFn, loss_fn: LossFn,
         check_vma=False)
     return fn(stacked_params, split_microbatches(x, num_microbatches),
               split_microbatches(targets, num_microbatches))
+
+
+def pipeline_lm_train_gpipe(stage_fn: StageFn, loss_fn, embed_fn,
+                            stacked_params: Any, embed_params: Any,
+                            head_params: Any, inputs: jax.Array,
+                            targets: jax.Array, mesh: Mesh,
+                            num_microbatches: int,
+                            axis_name: str = "pp"):
+    """GPipe counterpart of :func:`pipeline_lm_train_sharded`: forward
+    through the GPipe schedule, backward by autodiff. Fewer ticks
+    (m + pp - 1 vs m + 2(pp-1)) and no recompute, at the cost of an
+    O(m)-microbatch activation stash per stage — the faster schedule
+    whenever that stash fits in memory (measured: docs/benchmarks.md
+    pipeline table; 1F1B never beat it on any config that fit). Same
+    signature and return contract as the 1F1B variant, so callers
+    switch schedules without touching model code."""
+    def total_loss(sp, ep, hp):
+        h = embed_fn(ep, inputs)  # embedding lookup, any leading dims
+        y = pipeline_sharded(stage_fn, sp, h, mesh, num_microbatches,
+                             axis_name=axis_name)
+        # Mean loss over the full batch == mean over equal microbatches,
+        # so the scalar matches the 1F1B schedule's exactly.
+        return loss_fn(y, targets, hp)
+
+    loss, (sgrads, egrads, hgrads) = jax.value_and_grad(
+        total_loss, argnums=(0, 1, 2))(stacked_params, embed_params,
+                                       head_params)
+    return loss, sgrads, egrads, hgrads
+
+
+# Activation-memory safety margin for schedule selection: compiled peak
+# estimates undercount fragmentation/runtime buffers.
+_SCHEDULE_MEM_SAFETY = 0.9
+
+
+def select_schedule(gpipe_peak_bytes: Optional[int],
+                    budget_bytes: Optional[int]) -> str:
+    """Pick the pipeline schedule from the memory trade-off.
+
+    Measured result (docs/benchmarks.md pipeline table, r2-r4): GPipe
+    is faster than 1F1B on EVERY config where its O(m) activation stash
+    fits — 1F1B pays remat plus pp-1 extra ticks of schedule overhead;
+    its win is the O(pp) memory ceiling. So: GPipe when it fits, 1F1B
+    when it would not.
+
+    Fail SAFE, not open: with a known memory budget but an unknown
+    GPipe peak (probe unavailable/failed), pick 1F1B — the bounded-
+    memory schedule is the one that cannot OOM a model that fit
+    before. Only an unbounded budget (platform reports no limit)
+    defaults to GPipe.
+    """
+    if budget_bytes is None:
+        return "gpipe"
+    if gpipe_peak_bytes is None or gpipe_peak_bytes < 0:
+        return "1f1b"  # budget known, footprint unknown: don't gamble
+    if gpipe_peak_bytes <= budget_bytes * _SCHEDULE_MEM_SAFETY:
+        return "gpipe"
+    return "1f1b"
+
+
+def compiled_peak_bytes(compiled) -> Optional[int]:
+    """XLA's working-set estimate for a compiled computation: temp (the
+    activation stash lives here) plus non-aliased argument bytes. The
+    ONE formula both the trainer's auto probe and bench_pipeline report
+    — they must not diverge, or the bench's auto_choice columns would
+    stop describing what schedule="auto" actually does."""
+    try:
+        ma = compiled.memory_analysis()
+        return int(ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                   - ma.alias_size_in_bytes)
+    except Exception:
+        return None
 
 
 def pipeline_lm_train_sharded(stage_fn: StageFn, loss_fn, embed_fn,
