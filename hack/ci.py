@@ -1,0 +1,125 @@
+#!/usr/bin/env python3
+"""One-command CI: exactly what the hosted pipeline runs, runnable
+locally (the reference encodes its matrix as Argo workflows + Prow,
+test/workflows/components/workflows.libsonnet + prow_config.yaml;
+.github/workflows/ci.yaml mirrors this file).
+
+Stages, fail-fast in order:
+
+  1. lint        hack/py_checks.py (compile, unused imports,
+                 generated-files freshness — this stage alone would
+                 have caught the round-3 broken-entrypoint regression
+                 once paired with the control_plane shard)
+  2. control_plane  pytest -m control_plane   (fast operator signal)
+  3. compute        pytest -m compute         (model/kernel compiles)
+  4. e2e            pytest -m e2e             (subprocess pod suites)
+  5. bench-smoke    bench.py on whatever accelerator exists (CPU ok):
+                 asserts the benchmark ENTRYPOINT works and emits its
+                 one-line JSON contract, not a performance level.
+
+Usage:
+  python hack/ci.py               # everything
+  python hack/ci.py --stages lint,control_plane
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STAGES = ("lint", "control_plane", "compute", "e2e", "bench-smoke")
+
+SHARD_MARKS = ("control_plane", "compute", "e2e")
+
+
+def _check_marker_totality() -> int:
+    """Every test must carry a shard marker, or the shard matrix
+    silently skips it forever (each job deselects it, all stay green).
+    Enforced in lint so the failure names the unmarked tests."""
+    expr = " and ".join(f"not {m}" for m in SHARD_MARKS)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "--collect-only",
+         "-q", "-m", expr, "--color=no"],
+        cwd=REPO, capture_output=True, text=True)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if "::" in ln and not ln.startswith("=")]
+    if lines:
+        print("ci: [lint] tests with NO shard marker (would never run "
+              "in any CI shard):")
+        for ln in lines:
+            print(f"ci: [lint]   {ln}")
+        return 1
+    return 0
+
+
+def run(stage: str) -> int:
+    env = dict(os.environ)
+    if stage == "lint":
+        rc = _check_marker_totality()
+        if rc != 0:
+            return rc
+        cmd = [sys.executable, "hack/py_checks.py"]
+    elif stage in ("control_plane", "compute", "e2e"):
+        cmd = [sys.executable, "-m", "pytest", "tests/", "-q",
+               "-m", stage, "--color=no"]
+    elif stage == "bench-smoke":
+        cmd = [sys.executable, "bench.py"]
+        # Smoke contract: run wherever CI runs (usually CPU).
+        env.setdefault("JAX_PLATFORMS", "cpu")
+    else:
+        raise ValueError(stage)
+    t0 = time.monotonic()
+    print(f"ci: [{stage}] {' '.join(cmd)}", flush=True)
+    proc = subprocess.run(cmd, cwd=REPO, env=env,
+                          capture_output=(stage == "bench-smoke"),
+                          text=True)
+    if stage == "bench-smoke" and proc.returncode == 0:
+        # The contract: the LAST stdout line is one JSON object with
+        # the metric fields the driver records.
+        try:
+            line = proc.stdout.strip().splitlines()[-1]
+            rec = json.loads(line)
+            assert {"metric", "value", "unit",
+                    "vs_baseline"} <= set(rec), rec
+            print(f"ci: [bench-smoke] {line}")
+        except Exception as e:
+            print(f"ci: [bench-smoke] BAD OUTPUT CONTRACT: {e}\n"
+                  f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+            return 1
+    elif stage == "bench-smoke":
+        print(proc.stdout[-2000:])
+        print(proc.stderr[-2000:])
+    dt = time.monotonic() - t0
+    print(f"ci: [{stage}] {'ok' if proc.returncode == 0 else 'FAILED'} "
+          f"in {dt:.0f}s", flush=True)
+    return proc.returncode
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stages", default=",".join(STAGES),
+                    help=f"comma list from: {', '.join(STAGES)}")
+    args = ap.parse_args()
+    stages = [s.strip() for s in args.stages.split(",") if s.strip()]
+    for s in stages:
+        if s not in STAGES:
+            ap.error(f"unknown stage {s!r}")
+    results = {}
+    for stage in stages:
+        rc = run(stage)
+        results[stage] = rc
+        if rc != 0:
+            break  # fail fast; later stages would drown the signal
+    print("ci summary:", json.dumps(
+        {s: ("ok" if rc == 0 else "FAILED") for s, rc in results.items()}))
+    return 0 if all(rc == 0 for rc in results.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

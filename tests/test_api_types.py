@@ -90,3 +90,7 @@ def test_container_defaults():
     m = ObjectMeta()
     assert m.namespace == "default"
     assert m.controller_ref() is None
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+import pytest  # noqa: E402
+pytestmark = pytest.mark.control_plane

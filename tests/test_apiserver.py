@@ -228,3 +228,6 @@ def test_agent_claims_and_runs_pod(served, tmp_path):
         assert "hi from pod" in remote.read_logs("default", "hello")
     finally:
         agent.stop()
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

@@ -268,15 +268,19 @@ def test_no_accelerator_keeps_legacy_global_worker_env():
     assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 3
 
 
-def test_validation_warnings_ps_and_multislice_shape():
+def test_validation_warnings_multislice_shape():
     from tf_operator_tpu.api.validation import validation_warnings
 
     job = make_job(worker=6, ps=2, accelerator="v5p-32")
     job.spec.slice.num_slices = 2  # wants 8 workers, spec has 6
     warnings = validation_warnings(job)
-    assert any("parameter-server" in w for w in warnings)
     assert any("under- or over-subscribed" in w for w in warnings)
-    # A well-shaped job warns about neither.
+    # ps no longer warns: train/ps.py is a real runtime (round 4).
+    assert not any("parameter-server" in w for w in warnings)
+    # A well-shaped job warns about nothing.
     ok = make_job(worker=8, accelerator="v5p-32")
     ok.spec.slice.num_slices = 2
     assert validation_warnings(ok) == []
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

@@ -307,3 +307,6 @@ class TestDelayedWatchRaces(TestClaimRaceInvariants):
         operator.start(threadiness=2)
         yield operator
         operator.stop()
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

@@ -104,3 +104,6 @@ def test_version_wins_over_backend_validation(capsys):
     from tf_operator_tpu.cli import main
     assert main(["--backend", "none", "--version"]) == 0
     assert "tpu-operator" in capsys.readouterr().out
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

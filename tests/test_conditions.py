@@ -68,3 +68,7 @@ def test_failed_freezes_status():
     C.update_job_conditions(st, JobConditionType.SUCCEEDED, C.JOB_SUCCEEDED_REASON, "")
     assert types_of(st) == [("Failed", "True")]
     assert C.is_failed(st)
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+import pytest  # noqa: E402
+pytestmark = pytest.mark.control_plane

@@ -131,3 +131,6 @@ def test_validate_collects_multiple_errors():
     assert any("restartPolicy" in m for m in msgs)
     assert any("unknown replica type" in m for m in msgs)
     assert any("backoffLimit" in m for m in msgs)
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

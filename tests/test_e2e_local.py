@@ -886,3 +886,6 @@ def test_gang_infeasible_group_does_not_block_queue(tmp_path):
                                    for p in pods_toobig)
     finally:
         op.stop()
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.e2e

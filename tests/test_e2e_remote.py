@@ -236,3 +236,6 @@ def test_remote_invalid_spec_fails(client):
     client.create(job)
     got = client.wait_for_job("badjob", timeout=30)
     assert testutil.check_condition(got, JobConditionType.FAILED)
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.e2e

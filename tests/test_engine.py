@@ -433,3 +433,6 @@ def test_evaluator_failure_fails_job():
             testutil.new_pod(job, "evaluator", 0, phase=PodPhase.FAILED)]
     engine, plugin = run_status(job, pods)
     assert cond.is_failed(job.status)
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

@@ -18,3 +18,6 @@ def test_retryable(code):
 @pytest.mark.parametrize("code", [0, 3, 42, 100, 255])
 def test_unknown_treated_permanent(code):
     assert not is_retryable_exit_code(code)
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

@@ -78,3 +78,7 @@ def test_delete_for_job_clears_prefix():
 def test_expectation_key_layout():
     assert expectation_key("ns/j", "pods", "Worker") == "ns/j/worker/pods"
     assert expectation_key("ns/j", "pods") == "ns/j/pods"
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+import pytest  # noqa: E402
+pytestmark = pytest.mark.control_plane

@@ -204,3 +204,6 @@ def test_best_attention_rejects_indivisible_gqa_heads():
     kv = jnp.zeros((1, 128, 3, 128))
     with pytest.raises(ValueError, match="GQA head counts"):
         best_attention(q, kv, kv, causal=True)
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.compute

@@ -773,3 +773,6 @@ def test_e2e_no_preemption_flag_means_no_eviction(tmp_path):
         assert testutil.check_condition(job, JobConditionType.SUCCEEDED)
     finally:
         op.stop()
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

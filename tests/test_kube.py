@@ -711,3 +711,6 @@ users:
         raw = client.get(store_mod.TPUJOBS, "default", "clr")
         assert "completionTime" not in (raw.get("status") or {}), \
             "omitted field survived the status patch"
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

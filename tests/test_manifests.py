@@ -136,3 +136,34 @@ def test_rbac_manifest_parses_and_covers_runtime_verbs():
     assert {"get", "create", "update"} <= rules[
         ("coordination.k8s.io", "leases")]
     assert "create" in rules[("", "events")]
+
+def test_base_kustomization_lists_every_manifest():
+    """`kubectl apply -k` of the overlays resolves ../../base — the
+    base kustomization must exist and name exactly the deployable
+    manifests that live there (a missing entry silently skips a
+    resource; a stale one breaks the build)."""
+    base = os.path.join(REPO, "manifests", "base")
+    with open(os.path.join(base, "kustomization.yaml")) as f:
+        kust = yaml.safe_load(f)
+    listed = set(kust["resources"])
+    on_disk = {p for p in os.listdir(base)
+               if p.endswith(".yaml") and p != "kustomization.yaml"}
+    assert listed == on_disk, (listed, on_disk)
+
+
+@pytest.mark.parametrize("overlay", ("standalone", "kubeflow"))
+def test_overlays_reference_base(overlay):
+    path = os.path.join(REPO, "manifests", "overlays", overlay,
+                        "kustomization.yaml")
+    with open(path) as f:
+        kust = yaml.safe_load(f)
+    assert "../../base" in kust["resources"]
+    # Every locally-referenced resource file exists.
+    for res in kust["resources"]:
+        if not res.startswith(".."):
+            assert os.path.exists(os.path.join(os.path.dirname(path),
+                                               res)), res
+
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

@@ -511,3 +511,6 @@ class TestRingFlash:
             ring_flash_attention(jnp.zeros((1, 16, 2, 16)),
                                  jnp.zeros((1, 16, 2, 16)),
                                  jnp.zeros((1, 16, 2, 16)))
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.compute

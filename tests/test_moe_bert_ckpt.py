@@ -170,3 +170,7 @@ def test_checkpoint_restores_across_mesh_layouts(tmp_path):
     state_b, mb = step_b(restored, tok)
     assert abs(float(ma["loss"]) - float(mb["loss"])) < 5e-3
     ckpt.close()
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+import pytest  # noqa: E402
+pytestmark = pytest.mark.compute

@@ -133,3 +133,6 @@ def test_pipelines_yield_trainer_format():
     batch = next(iter(it))
     assert set(batch) == {"inputs", "labels"}
     getattr(it, "close", lambda: None)()
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.compute

@@ -309,3 +309,6 @@ def test_event_store_mirror_capped():
     assert count <= op_mod.MAX_STORED_EVENTS, count
     ev = op.store.list(store_mod.EVENTS)[0]
     assert ev.metadata.labels[constants.LABEL_JOB_NAME] == "capjob"
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

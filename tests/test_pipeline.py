@@ -543,3 +543,7 @@ def test_llama_pipeline_trainer_schedule_auto_and_forced():
     _, sh3 = tr3.init(jax.random.PRNGKey(73), tokens[:, :-1])
     tr3.make_train_step(sh3)
     assert tr3.resolved_schedule == "1f1b"
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+import pytest  # noqa: E402
+pytestmark = pytest.mark.compute

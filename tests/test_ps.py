@@ -196,3 +196,6 @@ def test_cluster_ps_addrs_parses_spec():
             '"task": {"type": "worker", "index": 0}}')
     assert cluster_ps_addrs(spec) == ["127.0.0.1:41000", "127.0.0.1:41001"]
     assert cluster_ps_addrs("") == []
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

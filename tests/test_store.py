@@ -115,3 +115,6 @@ def test_keys_returns_metadata_without_payload_copy():
     rvs = [rv for _, _, rv in ks]
     assert all(isinstance(rv, int) for rv in rvs)
     assert len(set(rvs)) == 3  # monotone resourceVersions, usable for age sort
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane
