@@ -150,6 +150,11 @@ def main() -> int:
             # 32-step scan-fused dispatch (amortizes the per-dispatch
             # host/tunnel cost the sync_corrected stat used to estimate
             # out). Batch 256/chip as in rounds 1-3.
+            # NOT raised further (e.g. k=64 / 192-step blocks reads
+            # 2 627): longer timed blocks only amortize the tunnel's
+            # fixed per-block sync cost — a measurement artifact the
+            # sync_corrected stat already isolates — and would break
+            # the round-over-round comparability of the median.
             imgs_per_sec, stats = bench_resnet50(batch_size=256,
                                                  image_size=224,
                                                  steps=96, warmup=32,
