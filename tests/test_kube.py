@@ -712,5 +712,55 @@ users:
         assert "completionTime" not in (raw.get("status") or {}), \
             "omitted field survived the status patch"
 
+
+class TestGangPdb:
+    def test_gang_job_gets_pdb_and_cleanup(self, client, fake):
+        """Reference SyncPdb parity: a gang-scheduled job gets a PDB
+        named after it (minAvailable = gang minMember, selecting the
+        job's pods, owner-referenced), and job deletion removes it."""
+        op = KubeOperator(client, post_events=False,
+                          enable_gang_scheduling=True, total_chips=64)
+        op.start(threadiness=1, sync_timeout=10)
+        try:
+            raw = make_job(name="gj", workers=3)
+            raw["spec"]["runPolicy"] = {
+                "schedulingPolicy": {"minAvailable": 2}}
+            client.create(store_mod.TPUJOBS, "default", raw)
+            pdb = wait_for(lambda: fake.state.objects[
+                "poddisruptionbudgets"].get(("default", "gj")),
+                msg="pdb created")
+            assert pdb["spec"]["minAvailable"] == 2
+            assert pdb["spec"]["selector"]["matchLabels"] == {
+                constants.LABEL_JOB_NAME: "gj"}
+            ref = pdb["metadata"]["ownerReferences"][0]
+            assert ref["kind"] == constants.KIND and ref["name"] == "gj"
+
+            # Level-triggered reconcile: minAvailable follows the gang
+            # threshold, and an out-of-band PDB deletion is repaired.
+            client.patch(store_mod.TPUJOBS, "default", "gj",
+                         {"spec": {"runPolicy": {
+                             "schedulingPolicy": {"minAvailable": 3}}}})
+            wait_for(lambda: fake.state.objects[
+                "poddisruptionbudgets"].get(("default", "gj"), {})
+                .get("spec", {}).get("minAvailable") == 3,
+                msg="pdb minAvailable patched to 3")
+            with fake.state.lock:
+                del fake.state.objects["poddisruptionbudgets"][
+                    ("default", "gj")]
+            # PDBs are not watched; repair rides the next job sync
+            # (any event or the periodic resync) — nudge one here.
+            client.patch(store_mod.TPUJOBS, "default", "gj",
+                         {"metadata": {"annotations": {"nudge": "1"}}})
+            wait_for(lambda: fake.state.objects[
+                "poddisruptionbudgets"].get(("default", "gj")),
+                msg="out-of-band-deleted pdb recreated on next sync")
+
+            client.delete(store_mod.TPUJOBS, "default", "gj")
+            wait_for(lambda: ("default", "gj") not in fake.state.objects[
+                "poddisruptionbudgets"], msg="pdb deleted with job")
+        finally:
+            op.stop()
+
+
 # CI shard (pyproject [tool.pytest.ini_options] markers)
 pytestmark = pytest.mark.control_plane

@@ -136,6 +136,8 @@ def test_rbac_manifest_parses_and_covers_runtime_verbs():
     assert {"get", "create", "update"} <= rules[
         ("coordination.k8s.io", "leases")]
     assert "create" in rules[("", "events")]
+    assert {"create", "delete"} <= rules[
+        ("policy", "poddisruptionbudgets")]
 
 def test_base_kustomization_lists_every_manifest():
     """`kubectl apply -k` of the overlays resolves ../../base — the

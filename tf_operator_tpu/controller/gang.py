@@ -138,6 +138,10 @@ class SliceGangScheduler(GangScheduler):
         # those as occupying would read every freshly created gang as
         # mid-eviction and kill its pods.
         self.scheduled_pods_occupy = scheduled_pods_occupy
+        # Optional PodDisruptionBudget sync (reference SyncPdb) — bound
+        # by the kube backend so cluster eviction machinery respects
+        # the gang's minMember; local backends have no evictor.
+        self.pdb_control = None
         self._lock = threading.Lock()
         # Groups already flagged infeasible / unknown-priority (log once).
         self._warned_infeasible: set = set()
@@ -183,6 +187,8 @@ class SliceGangScheduler(GangScheduler):
                 existing.spec = desired_spec
                 self.store.update(store_mod.SLICEGROUPS, existing)
             self._maybe_promote_running(existing, job)
+        if self.pdb_control is not None:
+            self.pdb_control.sync(job, min_member)
         self._admit()
 
     def _maybe_promote_running(self, group: SliceGroup, job: TPUJob) -> None:
@@ -210,6 +216,8 @@ class SliceGangScheduler(GangScheduler):
                          live, min_member)
 
     def delete_slice_group(self, job: TPUJob) -> None:
+        if self.pdb_control is not None:
+            self.pdb_control.delete(job)
         # try_delete's return is the atomicity seam: under concurrent
         # syncs only the worker whose delete landed counts/re-admits.
         if self.store.try_delete(store_mod.SLICEGROUPS,

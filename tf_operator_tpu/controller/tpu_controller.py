@@ -309,6 +309,15 @@ class TPUJobController(JobPlugin):
         if job is None:
             log.info("job %s vanished; clearing expectations", key)
             self.expectations.delete_for_job(key)
+            if self.engine.gang is not None:
+                # Gang residue is not all owner-GC'd: the PDB is (real
+                # clusters), but the fake apiserver and the informer
+                # mirror's SliceGroup need the explicit delete —
+                # level-triggered, no-op when nothing exists.
+                ref = TPUJob()
+                ref.metadata.name = name
+                ref.metadata.namespace = namespace
+                self.engine.gang.delete_slice_group(ref)
             return
 
         set_defaults(job)

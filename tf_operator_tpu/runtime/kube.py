@@ -70,6 +70,10 @@ log = logging.getLogger("tpu_operator.kube")
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+# Client-only resource kind (not mirrored in the Store): PDBs exist to
+# inform the CLUSTER's eviction machinery, nothing reconciles off them.
+KIND_PDBS = "poddisruptionbudgets"
+
 # Key-material temp files materialized from inline kubeconfig data;
 # removed at exit so credentials never persist in the tempdir.
 _TEMP_KEY_FILES: list = []
@@ -308,6 +312,9 @@ class KubeClient:
     def _path(self, kind: str, ns: Optional[str], name: str = "") -> str:
         if kind == store_mod.TPUJOBS:
             return self._crd(ns, name)
+        if kind == KIND_PDBS:
+            base = f"/apis/policy/v1/namespaces/{ns}/poddisruptionbudgets"
+            return f"{base}/{name}" if name else base
         resource = {store_mod.PODS: "pods",
                     store_mod.ENDPOINTS: "services",
                     store_mod.EVENTS: "events"}.get(kind)
@@ -622,6 +629,79 @@ class KubeEndpointControl(EndpointControl):
         metrics.deleted_endpoints.inc(job_namespace=namespace)
 
 
+class KubePdbControl:
+    """PodDisruptionBudget sync for gang-scheduled jobs (reference
+    SyncPdb, common/job_controller.go:247-284): one PDB per job, named
+    after it, minAvailable = the gang's minMember, selecting the job's
+    pods — so the CLUSTER's eviction machinery (node drains, autoscaler)
+    can't shrink a gang below its all-or-nothing threshold out from
+    under the scheduler. Owner-referenced: cluster GC reaps it with the
+    job; delete() covers backends without GC (the fake)."""
+
+    def __init__(self, client: KubeClient, recorder: Recorder):
+        self.client = client
+        self.recorder = recorder
+
+    def sync(self, job: TPUJob, min_available: int) -> None:
+        """Level-triggered like the reference (SyncPdb GETs every
+        reconcile): recreate an out-of-band-deleted PDB, patch
+        minAvailable when the gang's threshold changes."""
+        ns, name = job.metadata.namespace, job.metadata.name
+        want = int(min_available)
+        try:
+            current = None
+            try:
+                current = self.client.get(KIND_PDBS, ns, name)
+            except store_mod.NotFoundError:
+                pass
+            if current is None:
+                self.client.create(KIND_PDBS, ns, {
+                    "apiVersion": "policy/v1",
+                    "kind": "PodDisruptionBudget",
+                    "metadata": {
+                        "name": name,
+                        "ownerReferences": [
+                            controller_owner_ref(job).to_dict()],
+                    },
+                    "spec": {
+                        "minAvailable": want,
+                        "selector": {"matchLabels": {
+                            constants.LABEL_JOB_NAME: name}},
+                    },
+                })
+                self.recorder.event(job, EVENT_TYPE_NORMAL,
+                                    "SuccessfulCreatePdb",
+                                    f"Created PDB: {name} "
+                                    f"(minAvailable={want})")
+            elif (current.get("spec") or {}).get("minAvailable") != want:
+                self.client.patch(KIND_PDBS, ns, name,
+                                  {"spec": {"minAvailable": want}})
+                self.recorder.event(job, EVENT_TYPE_NORMAL,
+                                    "SuccessfulUpdatePdb",
+                                    f"PDB {name} minAvailable -> {want}")
+        except store_mod.AlreadyExistsError:
+            pass  # concurrent leader won the create; next sync verifies
+        except Exception as e:
+            # Best-effort (the reference tolerates pdb failure the same
+            # way): gang admission itself doesn't depend on the PDB —
+            # but degraded drain protection must be visible on the job.
+            self.recorder.event(job, EVENT_TYPE_WARNING, "FailedSyncPdb",
+                                f"Error syncing PDB: {e}")
+            log.warning("pdb sync for %s/%s failed: %s", ns, name, e)
+
+    def delete(self, job: TPUJob) -> None:
+        try:
+            self.client.delete(KIND_PDBS, job.metadata.namespace,
+                               job.metadata.name)
+        except store_mod.NotFoundError:
+            pass
+        except Exception as e:
+            self.recorder.event(job, EVENT_TYPE_WARNING, "FailedDeletePdb",
+                                f"Error deleting PDB: {e}")
+            log.warning("pdb delete for %s/%s failed: %s",
+                        job.metadata.namespace, job.metadata.name, e)
+
+
 # ---------------------------------------------------------------------------
 # Informer: cluster state -> Store cache
 # ---------------------------------------------------------------------------
@@ -837,13 +917,16 @@ class KubeJobController(TPUJobController):
         self.engine.pod_control = KubePodControl(client, self.recorder)
         self.engine.endpoint_control = KubeEndpointControl(client,
                                                            self.recorder)
-        if (self.engine.gang is not None
-                and getattr(self.engine.gang, "_pod_control_auto_bound",
-                            False)):
-            # Re-bind only the base class's auto-bound store control —
-            # evictions must go through the API server here. An
-            # explicitly constructed pod_control is respected.
-            self.engine.gang.pod_control = self.engine.pod_control
+        if self.engine.gang is not None:
+            if getattr(self.engine.gang, "_pod_control_auto_bound", False):
+                # Re-bind only the base class's auto-bound store control
+                # — evictions must go through the API server here. An
+                # explicitly constructed pod_control is respected.
+                self.engine.gang.pod_control = self.engine.pod_control
+            # Reference SyncPdb: protect admitted gangs from cluster
+            # eviction machinery (drains/autoscaler) via a PDB.
+            self.engine.gang.pdb_control = KubePdbControl(client,
+                                                          self.recorder)
 
     def update_job_status_in_api(self, job: TPUJob) -> None:
         """Status-subresource merge patch (reference

@@ -37,7 +37,8 @@ log = logging.getLogger("tpu_operator.kube_fake")
 
 _KEEPALIVE_SECONDS = 2.0
 
-RESOURCES = ("pods", "services", "events", "leases", constants.PLURAL)
+RESOURCES = ("pods", "services", "events", "leases",
+             "poddisruptionbudgets", constants.PLURAL)
 
 
 def merge_patch(target, patch):
@@ -340,6 +341,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif (parts[:3] == ["apis", constants.GROUP, constants.VERSION]):
             rest = parts[3:]
         elif parts[:3] == ["apis", "coordination.k8s.io", "v1"]:
+            rest = parts[3:]
+        elif parts[:3] == ["apis", "policy", "v1"]:
             rest = parts[3:]
         elif (parts[:3] == ["apis", "apiextensions.k8s.io", "v1"]
               and parts[3:4] == ["customresourcedefinitions"]):
