@@ -182,6 +182,50 @@ def test_control_plane_env_resolver(served):
     assert out["OTHER"] == "untouched"
 
 
+def test_control_plane_env_resolver_ps_cluster_spec(served):
+    """ps entries in TPUJOB_CLUSTER_SPEC resolve to the published pod
+    placements (host + the coordinator-named port the ps server binds);
+    other roles' entries stay DNS-named (identity, not dialed)."""
+    import json
+
+    from tf_operator_tpu.runtime.agent import ControlPlaneEnvResolver
+
+    store, remote = served
+    for i, (host, port) in enumerate((("10.9.0.1", 45001),
+                                      ("10.9.0.2", 45002))):
+        store.create(store_mod.PODS, Pod(
+            metadata=ObjectMeta(name=f"j-ps-{i}", namespace="ns1"),
+            status=PodStatus(host=host, ports={"coordinator": port})))
+    worker = Pod(metadata=ObjectMeta(name="j-worker-0", namespace="ns1"))
+    store.create(store_mod.PODS, worker)
+
+    spec = json.dumps({
+        "cluster": {"ps": ["j-ps-0.ns1.svc:2222", "j-ps-1.ns1.svc:2222"],
+                    "worker": ["j-worker-0.ns1.svc:2222"]},
+        "task": {"type": "worker", "index": 0}})
+    resolver = ControlPlaneEnvResolver(remote, timeout=5)
+    out = resolver.resolve(worker, {"TPUJOB_CLUSTER_SPEC": spec})
+    resolved = json.loads(out["TPUJOB_CLUSTER_SPEC"])
+    assert resolved["cluster"]["ps"] == ["10.9.0.1:45001",
+                                         "10.9.0.2:45002"]
+    assert resolved["cluster"]["worker"] == ["j-worker-0.ns1.svc:2222"]
+    assert resolved["task"] == {"type": "worker", "index": 0}
+
+
+def test_control_plane_env_resolver_no_ps_spec_untouched(served):
+    import json
+
+    from tf_operator_tpu.runtime.agent import ControlPlaneEnvResolver
+
+    _, remote = served
+    pod = Pod(metadata=ObjectMeta(name="p", namespace="ns1"))
+    spec = json.dumps({"cluster": {"worker": ["w0.ns1.svc:2222"]},
+                       "task": {"type": "worker", "index": 0}})
+    out = ControlPlaneEnvResolver(remote, timeout=1).resolve(
+        pod, {"TPUJOB_CLUSTER_SPEC": spec})
+    assert out["TPUJOB_CLUSTER_SPEC"] == spec  # verbatim, no blocking
+
+
 def test_control_plane_env_resolver_timeout(served):
     from tf_operator_tpu.runtime.agent import ControlPlaneEnvResolver
 
