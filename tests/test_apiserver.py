@@ -226,6 +226,39 @@ def test_control_plane_env_resolver_no_ps_spec_untouched(served):
     assert out["TPUJOB_CLUSTER_SPEC"] == spec  # verbatim, no blocking
 
 
+def test_control_plane_env_resolver_ps_error_paths(served):
+    """Error paths of the ps cluster-spec resolution: a placed ps pod
+    with no published port is a hard error (a silently-unreachable ps
+    would strand every worker), an unplaced ps pod times out like any
+    placement wait, and non-JSON spec values pass through verbatim."""
+    import json
+
+    from tf_operator_tpu.runtime.agent import ControlPlaneEnvResolver
+
+    store, remote = served
+    # Placed but portless: RuntimeError.
+    store.create(store_mod.PODS, Pod(
+        metadata=ObjectMeta(name="e-ps-0", namespace="ns1"),
+        status=PodStatus(host="10.9.1.1", ports={})))
+    pod = Pod(metadata=ObjectMeta(name="e-worker-0", namespace="ns1"))
+    spec = json.dumps({"cluster": {"ps": ["e-ps-0.ns1.svc:2222"]},
+                       "task": {"type": "worker", "index": 0}})
+    resolver = ControlPlaneEnvResolver(remote, timeout=2)
+    with pytest.raises(RuntimeError, match="published no port"):
+        resolver.resolve(pod, {"TPUJOB_CLUSTER_SPEC": spec})
+
+    # Never-placed ps pod: bounded TimeoutError, no hang.
+    spec2 = json.dumps({"cluster": {"ps": ["ghost-ps-0.ns1.svc:2222"]},
+                        "task": {"type": "worker", "index": 0}})
+    with pytest.raises(TimeoutError):
+        ControlPlaneEnvResolver(remote, timeout=0.3).resolve(
+            pod, {"TPUJOB_CLUSTER_SPEC": spec2})
+
+    # Unparseable spec: verbatim pass-through, not a crash.
+    out = resolver.resolve(pod, {"TPUJOB_CLUSTER_SPEC": "not-json"})
+    assert out["TPUJOB_CLUSTER_SPEC"] == "not-json"
+
+
 def test_control_plane_env_resolver_timeout(served):
     from tf_operator_tpu.runtime.agent import ControlPlaneEnvResolver
 
