@@ -41,6 +41,7 @@ def main() -> int:
     addrs = cluster_ps_addrs()
     if not addrs:
         raise SystemExit("no ps replicas in TPUJOB_CLUSTER_SPEC")
+    print(f"ps addrs: {','.join(addrs)}", flush=True)  # e2e asserts these
     worker_id = int(os.environ.get("TPU_WORKER_ID", "0"))
 
     # Tiny MLP on synthetic MNIST-shaped data; same seed everywhere so

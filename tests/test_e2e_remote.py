@@ -239,3 +239,47 @@ def test_remote_invalid_spec_fails(client):
 
 # CI shard (pyproject [tool.pytest.ini_options] markers)
 pytestmark = pytest.mark.e2e
+
+
+def test_remote_ps_job_trains_through_agent(client, tmp_path):
+    """The PS topology through the SERVED data plane: the node agent
+    claims the ps and worker pods, the control-plane resolver maps the
+    cluster spec's ps entries to published placements (the agent's
+    coordinator port doubles as the ps serving port), and async
+    training converges — no loopback localization anywhere."""
+    def spec(command, n):
+        return ReplicaSpec(
+            replicas=n,
+            template=PodTemplateSpec(spec=PodSpec(containers=[Container(
+                name=constants.DEFAULT_CONTAINER_NAME,
+                command=command,
+                env={"JAX_PLATFORMS": "cpu"})])))
+
+    job = TPUJob(
+        metadata=ObjectMeta(name="psagent"),
+        spec=TPUJobSpec(replica_specs={
+            "ps": spec([sys.executable, "-m",
+                        "tf_operator_tpu.train.ps", "--lr", "0.2"], 1),
+            "worker": spec([sys.executable,
+                            "examples/dist_mnist/dist_mnist_ps.py",
+                            "--steps", "15"], 1),
+        }))
+    job.spec.run_policy.clean_pod_policy = "None"
+    client.create(job)
+    got = client.wait_for_job("psagent", timeout=120)
+    assert testutil.check_condition(got, JobConditionType.SUCCEEDED)
+    logs = client.get_job_logs("psagent")
+    w0 = logs.get("psagent-worker-0", "")
+    assert "done:" in w0, w0[-500:]
+    first = float(w0.split("first=")[1].split(" ")[0])
+    last = float(w0.split("last=")[1].splitlines()[0])
+    assert last < first, (first, last)
+    # The worker dialed the ps pod's PUBLISHED placement (host +
+    # coordinator port), proving _resolve_cluster_spec rewrote the ps
+    # entry — not a loopback localization or a lucky DNS hit.
+    ps_pod = next(p for p in client.get_pods("psagent")
+                  if "-ps-" in p.metadata.name)
+    port = ps_pod.status.ports.get("coordinator")
+    assert port, ps_pod.status.ports
+    assert f"{ps_pod.status.host}:{port}" in w0.split(
+        "ps addrs: ")[1].splitlines()[0]
