@@ -241,7 +241,7 @@ def test_remote_invalid_spec_fails(client):
 pytestmark = pytest.mark.e2e
 
 
-def test_remote_ps_job_trains_through_agent(client, tmp_path):
+def test_remote_ps_job_trains_through_agent(client):
     """The PS topology through the SERVED data plane: the node agent
     claims the ps and worker pods, the control-plane resolver maps the
     cluster spec's ps entries to published placements (the agent's
@@ -271,8 +271,7 @@ def test_remote_ps_job_trains_through_agent(client, tmp_path):
     logs = client.get_job_logs("psagent")
     w0 = logs.get("psagent-worker-0", "")
     assert "done:" in w0, w0[-500:]
-    first = float(w0.split("first=")[1].split(" ")[0])
-    last = float(w0.split("last=")[1].splitlines()[0])
+    first, last = testutil.parse_ps_worker_log(w0)
     assert last < first, (first, last)
     # The worker dialed the ps pod's PUBLISHED placement (host +
     # coordinator port), proving _resolve_cluster_spec rewrote the ps
@@ -281,5 +280,5 @@ def test_remote_ps_job_trains_through_agent(client, tmp_path):
                   if "-ps-" in p.metadata.name)
     port = ps_pod.status.ports.get("coordinator")
     assert port, ps_pod.status.ports
-    assert f"{ps_pod.status.host}:{port}" in w0.split(
-        "ps addrs: ")[1].splitlines()[0]
+    dialed = w0.split("ps addrs: ")[1].splitlines()[0].split(",")
+    assert f"{ps_pod.status.host}:{port}" in dialed, dialed

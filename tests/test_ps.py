@@ -173,8 +173,7 @@ def test_e2e_ps_job_trains_async(tmp_path):
         logs = client.get_job_logs("psmnist")
         w0 = logs.get("psmnist-worker-0", "")
         assert "done:" in w0, w0[-500:]
-        first = float(w0.split("first=")[1].split(" ")[0])
-        last = float(w0.split("last=")[1].splitlines()[0])
+        first, last = testutil.parse_ps_worker_log(w0)
         assert last < first, (first, last)
         # ps pods were reaped on completion (CleanPodPolicy Running).
         deadline = time.monotonic() + 10

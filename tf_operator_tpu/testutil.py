@@ -227,3 +227,12 @@ def check_condition(job: TPUJob, cond_type: str, reason: str = "") -> bool:
     if c is None or c.status != "True":
         return False
     return (not reason) or c.reason == reason
+
+
+def parse_ps_worker_log(text: str):
+    """(first, last) windowed loss means from a dist_mnist_ps worker log
+    ('done: first=X last=Y') — the ONE parser for every suite that
+    asserts async-PS convergence."""
+    first = float(text.split("first=")[1].split(" ")[0])
+    last = float(text.split("last=")[1].splitlines()[0])
+    return first, last
