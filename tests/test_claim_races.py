@@ -129,7 +129,10 @@ class TestReleasePath:
             ref = p.metadata.controller_ref()
             return ref is None or ref.uid != job_b.metadata.uid
 
-        wait_for(settled, msg="jb released its relabeled pod")
+        # Generous budget: the release->adopt cycle rides rate-limited
+        # requeues that back off; under a fully loaded test shard the
+        # default 10s occasionally flakes.
+        wait_for(settled, timeout=30, msg="jb released its relabeled pod")
         # Whatever the interleaving, the system must converge back to a
         # fresh jb-owned, jb-labeled pod at index 0 once the name frees.
         p = op.store.try_get(store_mod.PODS, "default", "jb-worker-0")
@@ -145,7 +148,7 @@ class TestReleasePath:
         # jb is only re-synced by its own rate-limited requeue (the
         # freed name's DELETED event resolves to ja, the label match),
         # and repeated name-conflict failures back off up to 30s.
-        wait_for(refilled, timeout=40, msg="jb index refilled")
+        wait_for(refilled, timeout=90, msg="jb index refilled")
         # ja still has exactly its own pod, untouched.
         ja_pods = [p for p in job_pods(op, "ja") if owned_by(p, job_a)]
         assert [p.metadata.name for p in ja_pods] == ["ja-worker-0"]
