@@ -31,6 +31,8 @@ DEFAULT_SUITES = [
     "tests/test_claim_races.py",
     "tests/test_engine.py",
     "tests/test_bootstrap.py",
+    "tests/test_gang_admission.py",
+    "tests/test_ps.py",
 ]
 
 
