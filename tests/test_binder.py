@@ -1,0 +1,416 @@
+"""Slice-gang binder placement tests (controller/binder.py).
+
+The reference delegated binding to an external Volcano scheduler
+(common/job_controller.go:218-245 creates the PodGroup; Volcano gates
+and binds), so it has no binder logic to test. Here the operator itself
+places admitted gang pods; these tests drive ``bind_pass`` directly
+against the Store with a stub bind endpoint, asserting the placement
+contract: slice atomicity inside one ICI domain, all-or-nothing per
+slice, admission-gated, priority-ordered, restart-pinned, and settled
+on bind races.
+"""
+
+from typing import Dict, List, Optional
+
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import (
+    Container,
+    Node,
+    NodeSpec,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    SliceGroup,
+    SliceGroupSpec,
+    SliceGroupStatus,
+    TPUSliceSpec,
+)
+from tf_operator_tpu.controller.binder import (
+    SliceGangBinder,
+    node_ici_domain,
+    pod_chip_demand,
+)
+from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.store import Store
+
+
+class StubGang:
+    """The binder's two touchpoints on the scheduler, isolated."""
+
+    def __init__(self):
+        self.readmits = 0
+
+    def _priority_of(self, sg) -> int:
+        try:
+            return int(sg.spec.priority_class or 0)
+        except ValueError:
+            return 0
+
+    def readmit(self) -> None:
+        self.readmits += 1
+
+
+class StubBindClient:
+    """pods/binding endpoint semantics against the same Store: first
+    bind wins, a second bind 409s (kube_fake.bind_pod mirror)."""
+
+    def __init__(self, store: Store):
+        self.store = store
+        self.binds: List[tuple] = []
+        self.fail_names: set = set()
+        self.conflict_names: set = set()
+
+    def bind_pod(self, ns: str, name: str, node: str):
+        if name in self.fail_names:
+            raise OSError("injected bind transport failure")
+        if name in self.conflict_names:
+            # Mirror-lag race: another binder placed it but the MODIFIED
+            # event hasn't reached this binder's cache yet.
+            raise store_mod.ConflictError(
+                f"pod {ns}/{name} is already assigned to a node")
+        pod = self.store.get(store_mod.PODS, ns, name)
+        if pod.spec.node_name:
+            raise store_mod.ConflictError(
+                f"pod {ns}/{name} is already assigned to node "
+                f"{pod.spec.node_name}")
+        pod.spec.node_name = node
+        self.store.update(store_mod.PODS, pod)
+        self.binds.append((ns, name, node))
+
+
+def add_node(store: Store, name: str, chips: int = 8, domain: str = "",
+             unschedulable: bool = False, phase: str = "Ready") -> None:
+    labels = {constants.LABEL_ICI_DOMAIN: domain} if domain else {}
+    node = Node(
+        metadata=ObjectMeta(name=name, namespace="", labels=labels),
+        spec=NodeSpec(chips=chips, unschedulable=unschedulable))
+    node.status.phase = phase
+    store.create(store_mod.NODES, node)
+
+
+def add_group(store: Store, name: str, accelerator: str = "v5e-16",
+              num_slices: int = 1, phase: str = "Inqueue",
+              priority: str = "") -> SliceGroup:
+    sg = SliceGroup(
+        spec=SliceGroupSpec(
+            min_member=1, priority_class=priority,
+            slice=TPUSliceSpec(accelerator=accelerator,
+                               num_slices=num_slices)),
+        status=SliceGroupStatus(phase=phase))
+    sg.metadata.name = name
+    sg.metadata.namespace = "default"
+    return store.create(store_mod.SLICEGROUPS, sg)
+
+
+def add_pod(store: Store, group: str, rtype: str, index: int,
+            chips: Optional[int] = 8, node: str = "",
+            phase: str = "Pending",
+            scheduler: str = constants.DEFAULT_GANG_SCHEDULER,
+            gang_annotated: bool = True) -> Pod:
+    resources: Dict[str, str] = (
+        {constants.RESOURCE_TPU: str(chips)} if chips else {})
+    pod = Pod(spec=PodSpec(
+        containers=[Container(resources=resources)],
+        scheduler_name=scheduler, node_name=node))
+    pod.metadata.name = f"{group}-{rtype}-{index}"
+    pod.metadata.namespace = "default"
+    pod.metadata.labels = {
+        constants.LABEL_JOB_NAME: group,
+        constants.LABEL_REPLICA_TYPE: rtype,
+        constants.LABEL_REPLICA_INDEX: str(index),
+    }
+    if gang_annotated:
+        pod.metadata.annotations = {
+            constants.ANNOTATION_GANG_GROUP: group,
+            constants.ANNOTATION_GANG_TASK: rtype,
+        }
+    pod.status.phase = phase
+    return store.create(store_mod.PODS, pod)
+
+
+@pytest.fixture
+def store():
+    return Store()
+
+
+@pytest.fixture
+def gang():
+    return StubGang()
+
+
+@pytest.fixture
+def client(store):
+    return StubBindClient(store)
+
+
+@pytest.fixture
+def binder(store, client, gang):
+    return SliceGangBinder(store, client, gang)
+
+
+def bound_nodes(client) -> Dict[str, str]:
+    return {name: node for _, name, node in client.binds}
+
+
+class TestHelpers:
+    def test_pod_chip_demand_sums_containers(self):
+        pod = Pod(spec=PodSpec(containers=[
+            Container(resources={constants.RESOURCE_TPU: "4"}),
+            Container(resources={constants.RESOURCE_TPU: "2"}),
+            Container(resources={"cpu": "1"})]))
+        assert pod_chip_demand(pod) == 6
+
+    def test_pod_chip_demand_tolerates_garbage(self):
+        pod = Pod(spec=PodSpec(containers=[
+            Container(resources={constants.RESOURCE_TPU: "wat"})]))
+        assert pod_chip_demand(pod) == 0
+
+    def test_node_ici_domain_precedence(self):
+        n = Node(metadata=ObjectMeta(
+            name="n1", labels={constants.LABEL_ICI_DOMAIN: "pool-a",
+                               constants.LABEL_GKE_NODEPOOL: "gke-b"}))
+        assert node_ici_domain(n) == "pool-a"
+        n.metadata.labels.pop(constants.LABEL_ICI_DOMAIN)
+        assert node_ici_domain(n) == "gke-b"
+        n.metadata.labels.clear()
+        assert node_ici_domain(n) == "n1"
+
+
+class TestSliceAtomicity:
+    def test_whole_slice_lands_in_one_domain(self, store, client, gang,
+                                             binder):
+        # v5e-16: 16 chips, 2 hosts x 8. Two domains, each 2 nodes x 8.
+        for i in range(2):
+            add_node(store, f"a{i}", 8, "dom-a")
+            add_node(store, f"b{i}", 8, "dom-b")
+        add_group(store, "j1", "v5e-16")
+        add_pod(store, "j1", "worker", 0)
+        add_pod(store, "j1", "worker", 1)
+        assert binder.bind_pass() == 2
+        nodes = bound_nodes(client)
+        domains = {n[0] for n in nodes.values()}  # a* or b* prefix
+        assert len(nodes) == 2 and len(domains) == 1
+
+    def test_no_partial_bind_when_no_domain_fits(self, store, client,
+                                                 binder):
+        # Each domain has one 8-chip node; the slice needs 16 in ONE
+        # domain. All-or-nothing: zero binds, not one.
+        add_node(store, "a0", 8, "dom-a")
+        add_node(store, "b0", 8, "dom-b")
+        add_group(store, "j1", "v5e-16")
+        add_pod(store, "j1", "worker", 0)
+        add_pod(store, "j1", "worker", 1)
+        assert binder.bind_pass() == 0
+        assert client.binds == []
+
+    def test_multislice_slices_may_split_across_domains(self, store,
+                                                        client, binder):
+        # v5e-8 x2 slices: each slice = 1 host of 8 chips. Two domains
+        # with one 8-chip node each: slice 0 and slice 1 land on
+        # different domains (DCN between slices is by design).
+        add_node(store, "a0", 8, "dom-a")
+        add_node(store, "b0", 8, "dom-b")
+        add_group(store, "j1", "v5e-8", num_slices=2)
+        add_pod(store, "j1", "worker", 0)
+        add_pod(store, "j1", "worker", 1)
+        assert binder.bind_pass() == 2
+        assert set(bound_nodes(client).values()) == {"a0", "b0"}
+
+    def test_partially_bound_slice_pins_domain(self, store, client,
+                                               binder):
+        # worker-0 already runs in dom-b; the restarted worker-1 must
+        # follow it there even though dom-a has more free chips.
+        for i in range(2):
+            add_node(store, f"a{i}", 8, "dom-a")
+            add_node(store, f"b{i}", 8, "dom-b")
+        add_group(store, "j1", "v5e-16")
+        add_pod(store, "j1", "worker", 0, node="b0", phase="Running")
+        add_pod(store, "j1", "worker", 1)
+        assert binder.bind_pass() == 1
+        assert bound_nodes(client)["j1-worker-1"] == "b1"
+
+
+class TestAdmissionGate:
+    def test_unadmitted_group_stays_unbound(self, store, client, binder):
+        add_node(store, "a0", 16, "dom-a")
+        add_group(store, "j1", "v5e-16", phase="Pending")
+        add_pod(store, "j1", "worker", 0)
+        add_pod(store, "j1", "worker", 1)
+        assert binder.bind_pass() == 0
+
+    def test_missing_group_stays_unbound(self, store, client, binder):
+        add_node(store, "a0", 16, "dom-a")
+        add_pod(store, "orphan", "worker", 0)
+        assert binder.bind_pass() == 0
+
+    def test_non_gang_pods_ignored(self, store, client, binder):
+        add_node(store, "a0", 16, "dom-a")
+        add_group(store, "j1", "v5e-16")
+        add_pod(store, "j1", "worker", 0, scheduler="")
+        assert binder.bind_pass() == 0
+
+    def test_priority_group_binds_first_under_scarcity(self, store,
+                                                       client, binder):
+        # One 8-chip domain; two single-host groups admitted. The
+        # higher-priority one gets the chips regardless of creation
+        # order.
+        add_node(store, "a0", 8, "dom-a")
+        add_group(store, "low", "v5e-8", priority="1")
+        add_group(store, "high", "v5e-8", priority="100")
+        add_pod(store, "low", "worker", 0)
+        add_pod(store, "high", "worker", 0)
+        assert binder.bind_pass() == 1
+        assert bound_nodes(client) == {"high-worker-0": "a0"}
+
+
+class TestInventory:
+    def test_bound_pods_consume_chips(self, store, client, binder):
+        add_node(store, "a0", 8, "dom-a")
+        add_group(store, "j1", "v5e-8")
+        # A foreign bound pod holds 4 of the 8 chips.
+        foreign = Pod(spec=PodSpec(
+            containers=[Container(
+                resources={constants.RESOURCE_TPU: "4"})],
+            node_name="a0"))
+        foreign.metadata.name = "foreign"
+        foreign.metadata.namespace = "default"
+        foreign.status.phase = "Running"
+        store.create(store_mod.PODS, foreign)
+        add_pod(store, "j1", "worker", 0)  # needs 8
+        assert binder.bind_pass() == 0
+
+    def test_terminal_bound_pods_release_chips(self, store, client,
+                                               binder):
+        add_node(store, "a0", 8, "dom-a")
+        add_group(store, "j1", "v5e-8")
+        done = add_pod(store, "done", "worker", 0, node="a0",
+                       phase="Succeeded")
+        assert done.spec.node_name == "a0"
+        add_pod(store, "j1", "worker", 0)
+        assert binder.bind_pass() == 1
+
+    def test_cordoned_node_skipped_everywhere(self, store, client,
+                                              binder):
+        add_node(store, "a0", 8, "dom-a", unschedulable=True)
+        add_group(store, "j1", "v5e-8")
+        add_pod(store, "j1", "worker", 0)
+        assert binder.bind_pass() == 0
+
+    def test_notready_node_skipped(self, store, client, binder):
+        """A dead kubelet's Node persists with Ready=False; a direct
+        pods/binding POST would bypass the not-ready taint filter, so
+        the binder must apply it itself."""
+        add_node(store, "a0", 8, "dom-a", phase="NotReady")
+        add_group(store, "j1", "v5e-8")
+        add_pod(store, "j1", "worker", 0)
+        assert binder.bind_pass() == 0
+
+    def test_cordoned_peer_still_pins_slice_domain(self, store, client,
+                                                   binder):
+        """worker-0 runs on a now-cordoned dom-b node; recreated
+        worker-1 must still follow the slice into dom-b (placing it in
+        dom-a would split the slice across ICI domains)."""
+        for i in range(2):
+            add_node(store, f"a{i}", 8, "dom-a")
+        add_node(store, "b0", 8, "dom-b", unschedulable=True)
+        add_node(store, "b1", 8, "dom-b")
+        add_group(store, "j1", "v5e-16")
+        add_pod(store, "j1", "worker", 0, node="b0", phase="Running")
+        add_pod(store, "j1", "worker", 1)
+        assert binder.bind_pass() == 1
+        assert bound_nodes(client)["j1-worker-1"] == "b1"
+
+    def test_conflict_consumes_chips_in_pass(self, store, client,
+                                             binder):
+        """A 409 on bind proves the chips are contested: the pass must
+        not hand the same node to another group."""
+        add_node(store, "a0", 8, "dom-a")
+        add_group(store, "j1", "v5e-8", priority="100")
+        add_group(store, "j2", "v5e-8", priority="1")
+        add_pod(store, "j1", "worker", 0)
+        add_pod(store, "j2", "worker", 0)
+        client.conflict_names.add("j1-worker-0")
+        assert binder.bind_pass() == 0
+        assert client.binds == []  # j2 must NOT take the contested node
+
+    def test_node_change_triggers_readmit(self, store, client, gang,
+                                          binder):
+        binder.bind_pass()
+        assert gang.readmits == 1  # first inventory observation
+        binder.bind_pass()
+        assert gang.readmits == 1  # unchanged: no re-admission churn
+        add_node(store, "a0", 8, "dom-a")
+        binder.bind_pass()
+        assert gang.readmits == 2
+
+
+class TestFlexiblePods:
+    def test_coordinator_pod_binds_anywhere(self, store, client, binder):
+        add_node(store, "a0", 8, "dom-a")
+        add_group(store, "j1", "v5e-8")
+        add_pod(store, "j1", "chief", 0, chips=None)
+        add_pod(store, "j1", "worker", 0)
+        assert binder.bind_pass() == 2
+        assert "j1-chief-0" in bound_nodes(client)
+
+    def test_coordinator_prefers_most_free_node(self, store, client,
+                                                binder):
+        add_node(store, "small", 2, "dom-a")
+        add_node(store, "big", 8, "dom-b")
+        add_group(store, "j1", "v5e-8")
+        add_pod(store, "j1", "chief", 0, chips=None)
+        assert binder.bind_pass() == 1
+        assert bound_nodes(client)["j1-chief-0"] == "big"
+
+
+class TestBindRaces:
+    def test_conflict_is_settled_not_error(self, store, client, binder):
+        add_node(store, "a0", 8, "dom-a")
+        add_group(store, "j1", "v5e-8")
+        pod = add_pod(store, "j1", "worker", 0)
+        # Another binder wins the race after our cache snapshot: the
+        # stub raises Conflict because node_name is already set.
+        pod.spec.node_name = "a0"
+        store.update(store_mod.PODS, pod)
+        # Stale cache view: pass sees it unbound via the fetched list —
+        # simulate by operating on a pre-race listing.
+        assert binder.bind_pass() == 0  # conflict -> not counted
+
+    def test_transport_failure_retries_next_pass(self, store, client,
+                                                 binder):
+        add_node(store, "a0", 8, "dom-a")
+        add_group(store, "j1", "v5e-8")
+        add_pod(store, "j1", "worker", 0)
+        client.fail_names.add("j1-worker-0")
+        assert binder.bind_pass() == 0
+        client.fail_names.clear()
+        assert binder.bind_pass() == 1
+
+
+class TestBestFit:
+    def test_smallest_fitting_domain_chosen(self, store, client, binder):
+        # dom-big could fit the slice with room to spare; dom-tight fits
+        # exactly. Best-fit keeps the big domain whole.
+        add_node(store, "big0", 8, "dom-big")
+        add_node(store, "big1", 8, "dom-big")
+        add_node(store, "tight", 8, "dom-tight")
+        add_group(store, "j1", "v5e-8")
+        add_pod(store, "j1", "worker", 0)
+        assert binder.bind_pass() == 1
+        assert bound_nodes(client)["j1-worker-0"] == "tight"
+
+    def test_sub_host_slices_pack_one_node(self, store, client, binder):
+        # Two v5e-4 groups (4 chips, single host) share one 8-chip node.
+        add_node(store, "a0", 8, "dom-a")
+        for name in ("j1", "j2"):
+            add_group(store, name, "v5e-4")
+            add_pod(store, name, "worker", 0, chips=4)
+        assert binder.bind_pass() == 2
+        nodes = bound_nodes(client)
+        assert nodes["j1-worker-0"] == "a0" and nodes["j2-worker-0"] == "a0"
+
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

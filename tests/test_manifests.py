@@ -136,8 +136,12 @@ def test_rbac_manifest_parses_and_covers_runtime_verbs():
     assert {"get", "create", "update"} <= rules[
         ("coordination.k8s.io", "leases")]
     assert "create" in rules[("", "events")]
-    assert {"create", "delete"} <= rules[
+    # KubePdbControl.sync PATCHes minAvailable on gang-threshold change.
+    assert {"create", "delete", "patch"} <= rules[
         ("policy", "poddisruptionbudgets")]
+    # Slice-gang binder: node inventory reads + pods/binding writes.
+    assert {"get", "list", "watch"} <= rules[("", "nodes")]
+    assert "create" in rules[("", "pods/binding")]
 
 def test_base_kustomization_lists_every_manifest():
     """`kubectl apply -k` of the overlays resolves ../../base — the

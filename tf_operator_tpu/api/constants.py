@@ -61,3 +61,16 @@ ANNOTATION_GANG_TASK = "scheduling.tpu-operator.dev/task-spec"
 ANNOTATION_BOOTSTRAP_HASH = "tpu-operator.dev/bootstrap-hash"
 
 DEFAULT_GANG_SCHEDULER = "slice-gang"
+
+# Node label naming the ICI domain a TPU node belongs to: all hosts of
+# one slice must land inside one domain (chips are ICI-connected within
+# it; crossing domains means DCN). On GKE a TPU nodepool IS the ICI
+# domain, so the binder falls back to the nodepool label when the
+# first-class label is absent. No reference analog — the reference
+# delegated placement to Volcano, which is topology-blind.
+LABEL_ICI_DOMAIN = "tpu-operator.dev/ici-domain"
+LABEL_GKE_NODEPOOL = "cloud.google.com/gke-nodepool"
+
+# The extended-resource name TPU device plugins advertise on nodes and
+# pods request chips under (GKE convention).
+RESOURCE_TPU = "google.com/tpu"

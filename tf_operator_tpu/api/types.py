@@ -434,6 +434,9 @@ class NodeSpec(ApiObject):
     # Chip capacity this node contributes to gang admission accounting.
     chips: int = 0
     labels: Dict[str, str] = field(default_factory=dict)
+    # Cordoned (core/v1 Node.spec.unschedulable): the gang binder skips
+    # the node and its chips leave the admission capacity.
+    unschedulable: bool = False
 
 
 @dataclasses.dataclass

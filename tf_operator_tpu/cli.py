@@ -115,6 +115,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gang-preemption", action="store_true",
                    help="let higher-priority groups evict admitted-but-"
                         "not-yet-running lower-priority groups")
+    p.add_argument("--gang-binder", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="(kube backend) run the in-operator slice-gang "
+                        "pod binder: admitted gang pods are placed "
+                        "topology-aware onto nodes by the operator "
+                        "itself — no external Volcano-class scheduler. "
+                        "--no-gang-binder reverts to stamping "
+                        "schedulerName only (an external gang scheduler "
+                        "must then bind)")
     p.add_argument("--monitoring-port", type=int, default=8443,
                    help="port for /metrics, /healthz "
                         "(0 = disabled, -1 = ephemeral)")
@@ -190,6 +199,7 @@ class Server:
             self.operator = KubeOperator(
                 client,
                 namespace=args.namespace or None,
+                gang_binder=args.gang_binder,
                 **gang_kwargs)
             self.store = self.operator.store
             self._lease_store = KubeLeaseStore(client)

@@ -59,10 +59,24 @@ def _chips_for(group: SliceGroup) -> int:
     return topo.total_chips
 
 
+def _chips_per_slice(group: SliceGroup) -> int:
+    """Chips of ONE slice — the unit that must land whole inside one
+    ICI domain (multislice groups span domains over DCN by design)."""
+    sl = group.spec.slice
+    if not sl.accelerator:
+        return 0
+    from tf_operator_tpu.bootstrap.topology import parse_accelerator
+
+    return parse_accelerator(sl.accelerator, sl.topology,
+                             max(1, sl.num_slices)).chips
+
+
 class SliceGangScheduler(GangScheduler):
     """Priority/queue-aware whole-slice admission. ``total_chips=None`` =
     unlimited capacity (admission always succeeds, groups still tracked
-    for observability).
+    for observability) — unless a ``capacity_provider`` is bound, in
+    which case it supplies the budget per pass (the kube backend feeds
+    live node inventory through it; see controller/binder.py).
 
     Ordering: groups are considered by (priorityClass value desc,
     creation time asc) — a higher-priority group is always offered
@@ -113,11 +127,25 @@ class SliceGangScheduler(GangScheduler):
                  queue_quotas: Optional[Dict[str, int]] = None,
                  preemption: bool = False,
                  pod_control=None,
-                 scheduled_pods_occupy: bool = False):
+                 scheduled_pods_occupy: bool = False,
+                 capacity_provider=None,
+                 domain_capacity_provider=None):
         if fairness not in ("backfill", "strict", "aged"):
             raise ValueError(f"unknown gang fairness {fairness!r}")
         self.store = store
         self.total_chips = total_chips
+        # When total_chips is None, a provider (if bound) supplies the
+        # budget per admission pass — the kube backend derives it from
+        # live node inventory (sum of schedulable nodes' allocatable
+        # chips), so admission tracks the real cluster the way Volcano's
+        # allocator does, instead of trusting a static flag.
+        self.capacity_provider = capacity_provider
+        # Optional structural-feasibility probe: largest single ICI
+        # domain's chip capacity. A group whose per-slice chips exceed
+        # it can never be placed whole and is skipped as infeasible
+        # instead of booking budget forever (kube backend binds this to
+        # node inventory; None = no topology knowledge, aggregate only).
+        self.domain_capacity_provider = domain_capacity_provider
         self.fairness = fairness
         self.aging_seconds = aging_seconds
         self.priority_classes = dict(priority_classes or {})
@@ -215,6 +243,12 @@ class SliceGangScheduler(GangScheduler):
                          "%d); demoted to Inqueue", group.metadata.name,
                          live, min_member)
 
+    def readmit(self) -> None:
+        """Re-run admission off a capacity change (the binder calls this
+        when node inventory shifts — a job sync would otherwise be the
+        only trigger, stalling admission until the next resync)."""
+        self._admit()
+
     def delete_slice_group(self, job: TPUJob) -> None:
         if self.pdb_control is not None:
             self.pdb_control.delete(job)
@@ -228,9 +262,21 @@ class SliceGangScheduler(GangScheduler):
 
     def annotate_pod(self, job: TPUJob, pod: Pod, rtype: str) -> None:
         """Reference: schedulerName + group-name + task-spec annotations
-        (tensorflow/pod.go:221-235)."""
-        if not pod.spec.scheduler_name:
-            pod.spec.scheduler_name = constants.DEFAULT_GANG_SCHEDULER
+        (tensorflow/pod.go:221-235). The gang scheduler name is FORCED
+        (kubeflow common logs the same "Another scheduler is specified,
+        overwriting" warning): a template-supplied schedulerName would
+        hand the pod to a scheduler that binds before admission, which
+        the kube backend's occupancy probe reads as mid-eviction and
+        answers with a delete/recreate churn loop."""
+        if (pod.spec.scheduler_name
+                and pod.spec.scheduler_name
+                != constants.DEFAULT_GANG_SCHEDULER):
+            log.warning(
+                "pod %s template sets schedulerName=%r; gang scheduling "
+                "overrides it with %r (gang pods must gate on admission)",
+                pod.metadata.name, pod.spec.scheduler_name,
+                constants.DEFAULT_GANG_SCHEDULER)
+        pod.spec.scheduler_name = constants.DEFAULT_GANG_SCHEDULER
         pod.metadata.annotations[constants.ANNOTATION_GANG_GROUP] = \
             job.metadata.name
         pod.metadata.annotations[constants.ANNOTATION_GANG_TASK] = rtype
@@ -275,6 +321,16 @@ class SliceGangScheduler(GangScheduler):
         now = _now()
         to_evict: List[tuple] = []
         with self._lock:
+            # Effective chip budget for THIS pass: the static flag wins;
+            # otherwise a bound capacity provider reports live cluster
+            # capacity; otherwise unlimited. Valid only under the lock.
+            cap = self.total_chips
+            if cap is None and self.capacity_provider is not None:
+                cap = self.capacity_provider()
+            self._cap = cap
+            dom_cap = (self.domain_capacity_provider()
+                       if self.domain_capacity_provider is not None
+                       else None)
             groups = sorted(
                 self.store.list(store_mod.SLICEGROUPS),
                 key=lambda g: (-self._priority_of(g),
@@ -327,33 +383,41 @@ class SliceGangScheduler(GangScheduler):
                 need = _chips_for(group)
                 pri = self._priority_of(group)
                 quota = self.queue_quotas.get(q)
-                if ((self.total_chips is not None
-                     and need > self.total_chips)
-                        or (quota is not None and need > quota)):
-                    # Infeasible at ANY occupancy (cluster- or
-                    # quota-wise): can never be satisfied, so it must not
-                    # block the lane (the capacity-vs-request mismatch is
-                    # the operator's to fix, not later jobs' to wait
-                    # out). Flag once, not on every admission pass.
+                # Infeasible at ANY occupancy (cluster-, quota-, or
+                # domain-wise): can never be satisfied, so it must not
+                # block the lane or book budget (the capacity-vs-request
+                # mismatch is the operator's to fix, not later jobs' to
+                # wait out). The domain check is structural: a single
+                # slice larger than every ICI domain can never be placed
+                # WHOLE even though the aggregate budget fits it —
+                # admitting it would reserve chips the binder can never
+                # use and starve everything behind it. Flag once, not on
+                # every admission pass; all three re-evaluate per pass,
+                # so capacity growth un-skips automatically.
+                why = None
+                if self._cap is not None and need > self._cap:
+                    why = f"cluster capacity is {self._cap}"
+                elif quota is not None and need > quota:
+                    why = f"queue {q!r} quota is {quota}"
+                elif dom_cap is not None:
+                    slice_need = _chips_per_slice(group)
+                    if slice_need > dom_cap:
+                        why = (f"largest ICI domain holds {dom_cap} "
+                               f"chips and one slice needs {slice_need}")
+                if why is not None:
                     if key not in self._warned_infeasible:
                         self._warned_infeasible.add(key)
                         log.warning(
-                            "slice group %s needs %d chips but the %s "
-                            "is %s; skipping (infeasible)",
-                            group.metadata.name, need,
-                            "cluster" if (self.total_chips is not None
-                                          and need > self.total_chips)
-                            else f"queue {q!r} quota",
-                            self.total_chips
-                            if (self.total_chips is not None
-                                and need > self.total_chips) else quota)
+                            "slice group %s needs %d chips but the %s; "
+                            "skipping (infeasible)",
+                            group.metadata.name, need, why)
                     continue
                 if q in blocked:
                     floor = blocked[q]
                     if floor is None or pri < floor:
                         continue  # lane held for an earlier group
-                fits = ((self.total_chips is None
-                         or used + reserved + need <= self.total_chips)
+                fits = ((self._cap is None
+                         or used + reserved + need <= self._cap)
                         and (quota is None
                              or queue_used.get(q, 0) + need <= quota))
                 if not fits and self.preemption:
@@ -449,8 +513,8 @@ class SliceGangScheduler(GangScheduler):
         evicted or are mid-eviction — and the caller must earmark it.
         """
         def fits(u_, qu_):
-            return ((self.total_chips is None
-                     or u_ + reserved + need <= self.total_chips)
+            return ((self._cap is None
+                     or u_ + reserved + need <= self._cap)
                     and (quota is None or qu_.get(q, 0) + need <= quota))
 
         # Credit for evictions already in flight: their chips are in
@@ -486,8 +550,8 @@ class SliceGangScheduler(GangScheduler):
             # A victim only helps if it relieves a violated constraint:
             # any victim relieves the global budget; only same-queue
             # victims relieve this queue's quota.
-            global_tight = (self.total_chips is not None
-                            and u + reserved + need > self.total_chips)
+            global_tight = (self._cap is not None
+                            and u + reserved + need > self._cap)
             if not global_tight and vq != q:
                 continue
             c = _chips_for(v)

@@ -475,6 +475,24 @@ class TPUJobController(JobPlugin):
         # User-provided env wins over injected env? No: bootstrap identity
         # env must be authoritative (reference overwrites TF_CONFIG).
         container.env.update(env)
+        # Slice workers request their host's chips under google.com/tpu
+        # (device-plugin convention) — derived from the declared slice
+        # topology so the gang binder and kubelet account them, unless
+        # the template already declares an explicit chip request. The
+        # reference had no topology to derive from; users hand-wrote
+        # resources. Coordinator-only types (chief/ps/evaluator) hold no
+        # chips (bootstrap/cluster.py:236-243).
+        if (job.spec.slice.accelerator
+                and rtype.lower() == ReplicaType.WORKER
+                and not any(constants.RESOURCE_TPU in c.resources
+                            for c in pod.spec.containers)):
+            from tf_operator_tpu.bootstrap.topology import parse_accelerator
+
+            topo = parse_accelerator(job.spec.slice.accelerator,
+                                     job.spec.slice.topology,
+                                     max(1, job.spec.slice.num_slices))
+            container.resources[constants.RESOURCE_TPU] = str(
+                topo.devices_per_host)
 
     def bootstrap_hash(self, job: TPUJob, rtype: str, index: int) -> str:
         """sha1 over the WORLD a pod of this rtype joins — deliberately

@@ -50,6 +50,9 @@ from tf_operator_tpu.api.types import (
     ContainerStatus,
     Endpoint,
     EndpointSpec,
+    Node,
+    NodeSpec,
+    NodeStatus,
     ObjectMeta,
     OwnerReference,
     Pod,
@@ -315,6 +318,8 @@ class KubeClient:
         if kind == KIND_PDBS:
             base = f"/apis/policy/v1/namespaces/{ns}/poddisruptionbudgets"
             return f"{base}/{name}" if name else base
+        if kind == store_mod.NODES:
+            return self._core("nodes", None, name)  # cluster-scoped
         resource = {store_mod.PODS: "pods",
                     store_mod.ENDPOINTS: "services",
                     store_mod.EVENTS: "events"}.get(kind)
@@ -350,6 +355,18 @@ class KubeClient:
 
     def create_event(self, ns: str, body: dict) -> dict:
         return self.request("POST", self._core("events", ns), body=body)
+
+    def bind_pod(self, ns: str, name: str, node: str) -> dict:
+        """POST a Binding (the scheduler's pods/binding subresource write
+        — what kube-scheduler itself calls to place a pod). A 409 means
+        another binder won the race; callers treat it as settled."""
+        body = {"apiVersion": "v1", "kind": "Binding",
+                "metadata": {"name": name, "namespace": ns},
+                "target": {"apiVersion": "v1", "kind": "Node",
+                           "name": node}}
+        return self.request(
+            "POST", f"/api/v1/namespaces/{ns}/pods/{name}/binding",
+            body=body)
 
     def watch(self, kind: str, ns: Optional[str],
               selector: Optional[Dict[str, str]],
@@ -558,10 +575,41 @@ def tpujob_from_k8s(d: dict) -> TPUJob:
     return job
 
 
+def node_from_k8s(d: dict) -> Node:
+    """core/v1 Node -> the framework Node the agent registry also uses:
+    allocatable google.com/tpu chips become spec.chips, the ICI-domain
+    label rides metadata.labels, cordon maps onto spec.unschedulable."""
+    meta = _meta_from_k8s(d.get("metadata") or {})
+    meta.namespace = ""  # cluster-scoped
+    spec_d = d.get("spec") or {}
+    status_d = d.get("status") or {}
+    address = ""
+    for addr in status_d.get("addresses") or []:
+        if addr.get("type") == "InternalIP":
+            address = addr.get("address", "")
+            break
+    try:
+        chips = int(float((status_d.get("allocatable") or {})
+                          .get(constants.RESOURCE_TPU, 0) or 0))
+    except ValueError:
+        chips = 0
+    ready = "Ready"
+    for cond in status_d.get("conditions") or []:
+        if cond.get("type") == "Ready" and cond.get("status") != "True":
+            ready = "NotReady"
+    return Node(metadata=meta,
+                spec=NodeSpec(address=address, chips=chips,
+                              labels=dict(meta.labels),
+                              unschedulable=bool(
+                                  spec_d.get("unschedulable"))),
+                status=NodeStatus(phase=ready))
+
+
 FROM_K8S: Dict[str, Callable[[dict], object]] = {
     store_mod.TPUJOBS: tpujob_from_k8s,
     store_mod.PODS: pod_from_k8s,
     store_mod.ENDPOINTS: endpoint_from_k8s_service,
+    store_mod.NODES: node_from_k8s,
 }
 
 
@@ -1040,6 +1088,7 @@ class KubeOperator:
                  gang_priority_classes: Optional[dict] = None,
                  gang_queue_quotas: Optional[dict] = None,
                  gang_preemption: bool = False,
+                 gang_binder: bool = True,
                  config: Optional[EngineConfig] = None,
                  post_events: bool = True):
         self.client = client
@@ -1060,7 +1109,29 @@ class KubeOperator:
                                       # creating) already hold chips here;
                                       # nothing stamps gang_released on
                                       # the kube data plane.
-                                      scheduled_pods_occupy=True)
+                                      scheduled_pods_occupy=True,
+                                      # With the in-operator binder, an
+                                      # unset chip budget follows live
+                                      # node inventory instead of being
+                                      # unlimited.
+                                      capacity_provider=(
+                                          self._cluster_chip_capacity
+                                          if gang_binder
+                                          and total_chips is None
+                                          else None),
+                                      # Structural per-slice ceiling: a
+                                      # slice no ICI domain can hold is
+                                      # infeasible, not admitted-and-
+                                      # stuck (binder can't split it).
+                                      # Only when capacity is node-
+                                      # derived — an explicit
+                                      # --total-chips overrides node
+                                      # accounting wholesale.
+                                      domain_capacity_provider=(
+                                          self._max_domain_chip_capacity
+                                          if gang_binder
+                                          and total_chips is None
+                                          else None))
         self.controller = KubeJobController(client, store=self.store,
                                             recorder=recorder, config=config,
                                             gang=gang, namespace=namespace)
@@ -1074,6 +1145,48 @@ class KubeOperator:
             KubeInformer(client, self.store, store_mod.PODS, namespace),
             KubeInformer(client, self.store, store_mod.ENDPOINTS, namespace),
         ]
+        self.binder = None
+        if enable_gang_scheduling and gang_binder:
+            from tf_operator_tpu.controller.binder import SliceGangBinder
+
+            # Nodes are cluster-scoped: informer namespace is always None.
+            self.informers.append(
+                KubeInformer(client, self.store, store_mod.NODES, None))
+            self.binder = SliceGangBinder(self.store, client, gang,
+                                          namespace=namespace,
+                                          recorder=recorder)
+
+    def _cluster_chip_capacity(self) -> int:
+        """Gang admission budget from live node inventory: allocatable
+        TPU chips across schedulable, Ready nodes (Volcano allocator
+        analog — a cordoned or dead-kubelet node's chips must not admit
+        a gang the binder then cannot place)."""
+        from tf_operator_tpu.controller.binder import node_is_schedulable
+
+        total = 0
+        for n in self.store.list(store_mod.NODES):
+            if node_is_schedulable(n):
+                total += n.spec.chips
+        return total
+
+    def _max_domain_chip_capacity(self) -> Optional[int]:
+        """Largest single ICI domain's chip capacity — the structural
+        ceiling for ONE slice. A slice bigger than every domain can
+        never be placed whole; admission must not book budget for it
+        (gang.py domain_capacity_provider). None when no nodes are
+        known: zero topology knowledge must not flag everything
+        infeasible (the capacity budget already gates admission)."""
+        from tf_operator_tpu.controller.binder import (
+            node_ici_domain,
+            node_is_schedulable,
+        )
+
+        per_domain: Dict[str, int] = {}
+        for n in self.store.list(store_mod.NODES):
+            if node_is_schedulable(n):
+                dom = node_ici_domain(n)
+                per_domain[dom] = per_domain.get(dom, 0) + n.spec.chips
+        return max(per_domain.values(), default=None)
 
     def start(self, threadiness: int = 2,
               sync_timeout: float = 30.0) -> None:
@@ -1085,9 +1198,13 @@ class KubeOperator:
                 raise TimeoutError(f"informer {inf.kind} never synced "
                                    f"(API server unreachable?)")
         self.controller.run(threadiness=threadiness)
+        if self.binder is not None:
+            self.binder.start()
         log.info("kube operator started (threadiness=%d)", threadiness)
 
     def stop(self) -> None:
+        if self.binder is not None:
+            self.binder.stop()
         self.controller.stop()
         for inf in self.informers:
             inf.stop()

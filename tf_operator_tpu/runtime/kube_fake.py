@@ -38,7 +38,16 @@ log = logging.getLogger("tpu_operator.kube_fake")
 _KEEPALIVE_SECONDS = 2.0
 
 RESOURCES = ("pods", "services", "events", "leases",
-             "poddisruptionbudgets", constants.PLURAL)
+             "poddisruptionbudgets", "nodes", constants.PLURAL)
+
+# Cluster-scoped resources live under the "" namespace key.
+_CLUSTER_SCOPED = ("nodes",)
+
+
+def _default_ns(resource: str, ns) -> str:
+    if resource in _CLUSTER_SCOPED:
+        return ""
+    return ns or "default"
 
 
 def merge_patch(target, patch):
@@ -306,6 +315,45 @@ class FakeKubeState:
                                    "containerStatuses": statuses}},
                        subresource="status")
 
+    def add_node(self, name: str, chips: int = 8, ici_domain: str = "",
+                 labels: Optional[Dict[str, str]] = None,
+                 unschedulable: bool = False) -> dict:
+        """Register a core/v1 Node the way a kubelet + TPU device plugin
+        would: allocatable google.com/tpu chips plus the ICI-domain
+        label the gang binder keys slice affinity on."""
+        node_labels = dict(labels or {})
+        if ici_domain:
+            node_labels[constants.LABEL_ICI_DOMAIN] = ici_domain
+        obj = {"apiVersion": "v1", "kind": "Node",
+               "metadata": {"name": name, "labels": node_labels},
+               "spec": {"unschedulable": unschedulable},
+               "status": {"allocatable": {
+                   constants.RESOURCE_TPU: str(chips)},
+                   "addresses": [{"type": "InternalIP",
+                                  "address": "10.0.0.1"}]}}
+        return self.create("nodes", "", obj)
+
+    def cordon_node(self, name: str, unschedulable: bool = True) -> dict:
+        return self.patch("nodes", "", name,
+                          {"spec": {"unschedulable": unschedulable}})
+
+    def bind_pod(self, ns: str, name: str, node: str) -> dict:
+        """Bindings-API core: assign the pod to a node exactly once (a
+        real apiserver 409s a second bind — two schedulers racing must
+        not silently reassign a placed pod)."""
+        with self.lock:
+            pod = self.objects["pods"].get((ns, name))
+            if pod is None:
+                raise _HttpError(404, "NotFound", f"pod {ns}/{name} not found")
+            current = (pod.get("spec") or {}).get("nodeName", "")
+            if current:
+                raise _HttpError(
+                    409, "Conflict",
+                    f"pod {ns}/{name} is already assigned to node {current}")
+            self.patch("pods", ns, name, {"spec": {"nodeName": node}})
+        return _status_body(201, "Created", f"{name} bound to {node}") | {
+            "status": "Success"}
+
     def set_all_pods_phase(self, ns: str, phase: str, *,
                            selector: Optional[Dict[str, str]] = None) -> int:
         raw = ",".join(f"{k}={v}" for k, v in (selector or {}).items())
@@ -408,9 +456,8 @@ class _Handler(BaseHTTPRequestHandler):
             if resource == "pods" and name and sub == "log":
                 return self._serve_pod_log(ns or "default", name, query)
             if name:
-                return self._send_json(200,
-                                       self.state.get(resource, ns or
-                                                      "default", name))
+                return self._send_json(200, self.state.get(
+                    resource, _default_ns(resource, ns), name))
             if query.get("watch") in ("1", "true"):
                 return self._serve_watch(resource, ns, query)
             with self.state.lock:
@@ -423,11 +470,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         def run():
-            resource, ns, name, _, _q2 = self._route()
+            resource, ns, name, sub, _q2 = self._route()
+            if resource == "pods" and name and sub == "binding":
+                body = self._read_body()
+                target = (body.get("target") or {}).get("name", "")
+                if not target:
+                    raise _HttpError(400, "Invalid", "binding target required")
+                return self._send_json(201, self.state.bind_pod(
+                    ns or "default", name, target))
             if name:
                 raise _HttpError(405, "MethodNotAllowed", "POST to item")
-            self._send_json(201, self.state.create(resource, ns or "default",
-                                                   self._read_body()))
+            self._send_json(201, self.state.create(
+                resource, _default_ns(resource, ns), self._read_body()))
         self._guard(run)
 
     def do_DELETE(self):
@@ -435,8 +489,8 @@ class _Handler(BaseHTTPRequestHandler):
             resource, ns, name, _, _q2 = self._route()
             if not name:
                 raise _HttpError(405, "MethodNotAllowed", "DELETE collection")
-            self._send_json(200, self.state.delete(resource, ns or "default",
-                                                   name))
+            self._send_json(200, self.state.delete(
+                resource, _default_ns(resource, ns), name))
         self._guard(run)
 
     def do_PUT(self):
@@ -444,8 +498,9 @@ class _Handler(BaseHTTPRequestHandler):
             resource, ns, name, _, _q2 = self._route()
             if not name:
                 raise _HttpError(405, "MethodNotAllowed", "PUT collection")
-            self._send_json(200, self.state.replace(resource, ns or "default",
-                                                    name, self._read_body()))
+            self._send_json(200, self.state.replace(
+                resource, _default_ns(resource, ns), name,
+                self._read_body()))
         self._guard(run)
 
     def do_PATCH(self):
@@ -457,9 +512,9 @@ class _Handler(BaseHTTPRequestHandler):
             if "merge-patch" not in ctype and "strategic" not in ctype:
                 raise _HttpError(415, "UnsupportedMediaType",
                                  f"unsupported patch type {ctype}")
-            self._send_json(200, self.state.patch(resource, ns or "default",
-                                                  name, self._read_body(),
-                                                  subresource=sub))
+            self._send_json(200, self.state.patch(
+                resource, _default_ns(resource, ns), name,
+                self._read_body(), subresource=sub))
         self._guard(run)
 
     # -- pod logs (kubelet log API subresource) ----------------------------

@@ -264,6 +264,10 @@ slicegroups_preempted = REGISTRY.counter(
     "tpu_operator_slicegroups_preempted_total",
     "Counts gang SliceGroups evicted back to Pending by higher-priority "
     "admission", ["job_namespace"])
+gang_pods_bound = REGISTRY.counter(
+    "tpu_operator_gang_pods_bound_total",
+    "Counts pods the in-operator slice-gang binder bound to nodes",
+    ["job_namespace"])
 is_leader = REGISTRY.gauge(
     "tpu_operator_is_leader",
     "1 while this operator replica holds the leader lease")
