@@ -306,5 +306,266 @@ def test_agent_claims_and_runs_pod(served, tmp_path):
     finally:
         agent.stop()
 
+class TestAuthAndTls:
+    """Round-5 security contract: bearer tokens with roles, fail-closed
+    non-loopback binds, and TLS with a self-signed bootstrap (the
+    reference gets all of this from the K8s API server;
+    tf_job_client.py:55-76 / cluster-role.yaml)."""
+
+    TOKENS = {"admin-secret": "admin", "viewer-secret": "read-only"}
+
+    @pytest.fixture
+    def authed(self):
+        store = Store()
+        server = APIServer(store, port=0, tokens=self.TOKENS).start()
+        wait_for_server(server.url)
+        yield store, server
+        server.stop()
+        store.stop_watchers()
+
+    def test_healthz_open_without_token(self, authed):
+        _, server = authed
+        wait_for_server(server.url)  # unauthenticated probe succeeds
+
+    def test_unauthenticated_request_401(self, authed):
+        _, server = authed
+        remote = RemoteStore(server.url)  # no token
+        with pytest.raises(RuntimeError, match="401"):
+            remote.create(store_mod.TPUJOBS,
+                          testutil.new_tpujob(worker=1, name="nope"))
+        with pytest.raises(RuntimeError, match="401"):
+            remote.list(store_mod.TPUJOBS)
+
+    def test_bad_token_401(self, authed):
+        _, server = authed
+        remote = RemoteStore(server.url, token="wrong")
+        with pytest.raises(RuntimeError, match="401"):
+            remote.list(store_mod.TPUJOBS)
+
+    def test_admin_full_access(self, authed):
+        store, server = authed
+        remote = RemoteStore(server.url, token="admin-secret")
+        remote.create(store_mod.TPUJOBS,
+                      testutil.new_tpujob(worker=1, name="aj"))
+        assert store.try_get(store_mod.TPUJOBS, "default", "aj")
+        remote.delete(store_mod.TPUJOBS, "default", "aj")
+
+    def test_read_only_can_read_not_write(self, authed):
+        store, server = authed
+        store.create(store_mod.TPUJOBS,
+                     testutil.new_tpujob(worker=1, name="ro"))
+        remote = RemoteStore(server.url, token="viewer-secret")
+        assert remote.get(store_mod.TPUJOBS, "default", "ro")
+        assert len(remote.list(store_mod.TPUJOBS)) == 1
+        with pytest.raises(RuntimeError, match="403"):
+            remote.create(store_mod.TPUJOBS,
+                          testutil.new_tpujob(worker=1, name="ro2"))
+        with pytest.raises(RuntimeError, match="403"):
+            remote.delete(store_mod.TPUJOBS, "default", "ro")
+
+    def test_authed_watch_streams(self, authed):
+        store, server = authed
+        remote = RemoteStore(server.url, token="viewer-secret")
+        seen = []
+        ev = threading.Event()
+
+        def on_event(et, obj):
+            seen.append((et, obj.metadata.name))
+            ev.set()
+
+        w = remote.watch(store_mod.TPUJOBS, on_event)
+        try:
+            store.create(store_mod.TPUJOBS,
+                         testutil.new_tpujob(worker=1, name="wj"))
+            assert ev.wait(10), "authed watch never delivered"
+            assert ("ADDED", "wj") in seen
+        finally:
+            w.stop()
+
+    def test_keepalive_connection_survives_rejected_write(self, authed):
+        """A 401/403 decided before the body is read must still drain
+        it — otherwise the next request on a keep-alive connection
+        parses from the stale body bytes."""
+        import http.client
+        import json as _json
+
+        _, server = authed
+        host, port = server.url.replace("http://", "").split(":")
+        conn = http.client.HTTPConnection(host, int(port))
+        try:
+            body = _json.dumps({"metadata": {"name": "x"}})
+            conn.request("POST", "/apis/v1/tpujobs", body=body,
+                         headers={"Authorization": "Bearer viewer-secret",
+                                  "Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 403
+            resp.read()
+            # Same connection, next request must parse cleanly.
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert b"ok" in resp.read()
+        finally:
+            conn.close()
+
+    def test_agent_log_server_requires_capability_url(self, served,
+                                                      tmp_path):
+        """Pod logs on the agent are only reachable through the random
+        capability prefix published behind the authed control plane —
+        a bare network peer probing the port gets 404."""
+        import urllib.error
+        import urllib.request
+
+        from tf_operator_tpu.runtime.agent import NodeAgent
+
+        store, remote = served
+        agent = NodeAgent(remote.base_url, name="cap-agent",
+                          workdir=str(tmp_path)).start()
+        try:
+            port = agent._log_httpd.server_address[1]
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/logs/default/p", timeout=5)
+            assert err.value.code == 404
+            # The published URL carries the capability prefix.
+            assert agent.log_secret in agent.log_url
+        finally:
+            agent.stop()
+
+    def test_non_loopback_anonymous_fail_closed(self):
+        store = Store()
+        server = APIServer(store, host="0.0.0.0", port=0).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            wait_for_server(url)  # healthz stays open
+            remote = RemoteStore(url)
+            with pytest.raises(RuntimeError, match="401"):
+                remote.list(store_mod.TPUJOBS)
+        finally:
+            server.stop()
+            store.stop_watchers()
+
+    def test_non_loopback_insecure_opt_out(self):
+        store = Store()
+        server = APIServer(store, host="0.0.0.0", port=0,
+                           insecure=True).start()
+        try:
+            remote = RemoteStore(f"http://127.0.0.1:{server.port}")
+            assert remote.list(store_mod.TPUJOBS) == []
+        finally:
+            server.stop()
+            store.stop_watchers()
+
+
+class TestTls:
+    @pytest.fixture
+    def tls_files(self, tmp_path):
+        from tf_operator_tpu.runtime.tlsutil import ensure_self_signed
+
+        cert, key = str(tmp_path / "cert.pem"), str(tmp_path / "key.pem")
+        ensure_self_signed(cert, key)
+        return cert, key
+
+    def test_key_file_is_0600(self, tls_files):
+        import os
+        import stat
+
+        _, key = tls_files
+        mode = stat.S_IMODE(os.stat(key).st_mode)
+        assert mode == 0o600, oct(mode)
+
+    def test_tls_roundtrip_with_auth(self, tls_files, tmp_path):
+        cert, key = tls_files
+        store = Store()
+        server = APIServer(store, port=0, tls_cert=cert, tls_key=key,
+                           tokens={"t": "admin"}).start()
+        try:
+            assert server.url.startswith("https://")
+            wait_for_server(server.url, ca_file=cert)
+            remote = RemoteStore(server.url, token="t", ca_file=cert)
+            remote.create(store_mod.TPUJOBS,
+                          testutil.new_tpujob(worker=1, name="tj"))
+            assert remote.get(store_mod.TPUJOBS, "default", "tj")
+            # Watch works over TLS too.
+            ev = threading.Event()
+            w = remote.watch(store_mod.TPUJOBS, lambda *a: ev.set())
+            try:
+                assert ev.wait(10), "TLS watch never delivered replay"
+            finally:
+                w.stop()
+        finally:
+            server.stop()
+            store.stop_watchers()
+
+    def test_unverified_client_rejected(self, tls_files):
+        import urllib.error
+
+        cert, key = tls_files
+        store = Store()
+        server = APIServer(store, port=0, tls_cert=cert,
+                           tls_key=key).start()
+        try:
+            remote = RemoteStore(server.url)  # no CA bundle
+            with pytest.raises((OSError, urllib.error.URLError)):
+                remote.list(store_mod.TPUJOBS)
+            # insecure_skip_verify opts out (dev only).
+            remote = RemoteStore(server.url, insecure_skip_verify=True)
+            assert remote.list(store_mod.TPUJOBS) == []
+        finally:
+            server.stop()
+            store.stop_watchers()
+
+    def test_ensure_self_signed_idempotent(self, tls_files):
+        from tf_operator_tpu.runtime.tlsutil import ensure_self_signed
+
+        cert, key = tls_files
+        before = open(cert).read()
+        ensure_self_signed(cert, key)
+        assert open(cert).read() == before
+
+
+class TestTokenFile:
+    def test_load_tokens(self, tmp_path):
+        from tf_operator_tpu.runtime import tlsutil
+
+        path = tmp_path / "tokens"
+        path.write_text("# ops\nadmintok admin\n\nviewtok read-only\n"
+                        "defaulttok\n")
+        assert tlsutil.load_tokens(str(path)) == {
+            "admintok": "admin", "viewtok": "read-only",
+            "defaulttok": "admin"}
+
+    def test_load_tokens_rejects_bad_role(self, tmp_path):
+        from tf_operator_tpu.runtime import tlsutil
+
+        path = tmp_path / "tokens"
+        path.write_text("tok superuser\n")
+        with pytest.raises(ValueError, match="unknown role"):
+            tlsutil.load_tokens(str(path))
+
+    def test_read_token_skips_blanks_and_comments(self, tmp_path):
+        from tf_operator_tpu.runtime import tlsutil
+
+        path = tmp_path / "tokens"
+        path.write_text("\n# operator tokens\n\nadmintok admin\n")
+        assert tlsutil.read_token(str(path)) == "admintok"
+        empty = tmp_path / "none"
+        empty.write_text("# nothing\n\n")
+        with pytest.raises(ValueError, match="no token"):
+            tlsutil.read_token(str(empty))
+
+    def test_load_tokens_rejects_duplicates_and_empty(self, tmp_path):
+        from tf_operator_tpu.runtime import tlsutil
+
+        dup = tmp_path / "dup"
+        dup.write_text("tok\ntok read-only\n")
+        with pytest.raises(ValueError, match="duplicate"):
+            tlsutil.load_tokens(str(dup))
+        empty = tmp_path / "empty"
+        empty.write_text("# nothing\n")
+        with pytest.raises(ValueError, match="no tokens"):
+            tlsutil.load_tokens(str(empty))
+
+
 # CI shard (pyproject [tool.pytest.ini_options] markers)
 pytestmark = pytest.mark.control_plane

@@ -24,6 +24,7 @@ import argparse
 import logging
 import os
 import signal
+import socket
 import sys
 import threading
 from typing import List, Optional
@@ -134,6 +135,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "clients and node agents connect here")
     p.add_argument("--api-host", default="127.0.0.1",
                    help="bind address for the control-plane API")
+    p.add_argument("--api-tokens-file", default=None,
+                   help="bearer-token file for the served API: one "
+                        "'<token> [role]' per line, role admin "
+                        "(default) or read-only. Without it, a "
+                        "non-loopback --api-host rejects everything "
+                        "but /healthz with 401 (see --api-insecure)")
+    p.add_argument("--api-tls-cert", default=None,
+                   help="TLS certificate (PEM) for the served API")
+    p.add_argument("--api-tls-key", default=None,
+                   help="TLS private key (PEM) for the served API")
+    p.add_argument("--api-self-signed-tls-dir", default=None,
+                   help="generate (once) and serve a self-signed TLS "
+                        "cert/key pair under this directory — "
+                        "first-run bootstrap; clients verify with the "
+                        "generated cert.pem as --ca-cert")
+    p.add_argument("--api-tls-san", default="",
+                   help="comma-separated extra subject-alt-names "
+                        "(DNS names or IPs) for the self-signed cert — "
+                        "whatever remote clients will dial, e.g. "
+                        "'operator.example.com,10.0.0.5'")
+    p.add_argument("--api-insecure", action="store_true",
+                   help="explicitly allow anonymous access to the "
+                        "served API on a non-loopback bind (NOT for "
+                        "production)")
     p.add_argument("--backend", choices=("local", "none", "kube"),
                    default="local",
                    help="data plane: 'local' runs pods as subprocesses "
@@ -216,9 +241,43 @@ class Server:
         if getattr(args, "api_port", 0) != 0:
             from tf_operator_tpu.runtime.apiserver import APIServer
 
-            self.api_server = APIServer(self.store,
-                                        host=args.api_host,
-                                        port=max(args.api_port, 0))
+            tls_cert = getattr(args, "api_tls_cert", None)
+            tls_key = getattr(args, "api_tls_key", None)
+            ss_dir = getattr(args, "api_self_signed_tls_dir", None)
+            if ss_dir:
+                from tf_operator_tpu.runtime.tlsutil import (
+                    ensure_self_signed,
+                )
+
+                tls_cert = os.path.join(ss_dir, "cert.pem")
+                tls_key = os.path.join(ss_dir, "key.pem")
+                import ipaddress as _ip
+
+                dns = ["localhost", socket.gethostname()]
+                ips = ["127.0.0.1"]
+                if args.api_host not in ("0.0.0.0", "::", ""):
+                    ips.append(args.api_host)
+                for san in getattr(args, "api_tls_san", "").split(","):
+                    san = san.strip()
+                    if not san:
+                        continue
+                    try:
+                        _ip.ip_address(san)
+                        ips.append(san)
+                    except ValueError:
+                        dns.append(san)
+                ensure_self_signed(tls_cert, tls_key, dns_names=dns,
+                                   ip_addresses=ips)
+            tokens = None
+            if getattr(args, "api_tokens_file", None):
+                from tf_operator_tpu.runtime.tlsutil import load_tokens
+
+                tokens = load_tokens(args.api_tokens_file)
+            self.api_server = APIServer(
+                self.store, host=args.api_host,
+                port=max(args.api_port, 0),
+                tls_cert=tls_cert, tls_key=tls_key, tokens=tokens,
+                insecure=getattr(args, "api_insecure", False))
         self.monitoring: Optional[MonitoringServer] = None
         if args.monitoring_port != 0:
             self.monitoring = MonitoringServer(

@@ -160,12 +160,21 @@ class _LogHandler(BaseHTTPRequestHandler):
         parsed = urllib.parse.urlsplit(self.path)
         parts = [p for p in parsed.path.split("/") if p]
         query = urllib.parse.parse_qs(parsed.query)
-        if len(parts) != 3 or parts[0] != "logs":
+        # Capability-URL auth: the random path prefix is only published
+        # in node.status.log_url behind the AUTHENTICATED control
+        # plane, so direct unauthenticated reads from the network get
+        # 404 (and learn nothing). hmac.compare_digest: no timing
+        # oracle on the secret.
+        import hmac
+
+        if (len(parts) != 4 or parts[1] != "logs"
+                or not hmac.compare_digest(parts[0],
+                                           self.agent.log_secret)):
             self.send_response(404)
             self.send_header("Content-Length", "0")
             self.end_headers()
             return
-        ns, name = parts[1], parts[2]
+        ns, name = parts[2], parts[3]
         follow = (query.get("follow") or ["0"])[0] not in ("", "0", "false")
         tail = (query.get("tailLines") or [None])[0]
         try:
@@ -227,8 +236,12 @@ class NodeAgent:
                  workdir: Optional[str] = None,
                  extra_env: Optional[Dict[str, str]] = None,
                  log_port: int = 0,
-                 resolve_timeout: float = RESOLVE_TIMEOUT_SECONDS):
-        self.store = RemoteStore(server_url)
+                 resolve_timeout: float = RESOLVE_TIMEOUT_SECONDS,
+                 token: Optional[str] = None,
+                 ca_file: Optional[str] = None,
+                 insecure_skip_verify: bool = False):
+        self.store = RemoteStore(server_url, token=token, ca_file=ca_file,
+                                 insecure_skip_verify=insecure_skip_verify)
         self.name = name or f"node-{socket.gethostname()}-{os.getpid()}"
         self.address = address
         self.chips = chips
@@ -237,6 +250,13 @@ class NodeAgent:
             resolver=ControlPlaneEnvResolver(self.store,
                                              timeout=resolve_timeout),
             pod_filter=lambda pod: pod.spec.node_name == self.name)
+        # Random capability prefix for the log server: only readers of
+        # node.status.log_url (behind the authed control plane) can
+        # construct valid log URLs — a bare network peer hitting the
+        # port gets 404s. Rotates every agent restart.
+        import secrets
+
+        self.log_secret = secrets.token_urlsafe(16)
         handler = type("BoundLogHandler", (_LogHandler,), {"agent": self})
         self._log_httpd = ThreadingHTTPServer(("0.0.0.0", log_port), handler)
         self._log_httpd.daemon_threads = True
@@ -248,7 +268,8 @@ class NodeAgent:
 
     @property
     def log_url(self) -> str:
-        return f"http://{self.address}:{self._log_httpd.server_address[1]}"
+        port = self._log_httpd.server_address[1]
+        return f"http://{self.address}:{port}/{self.log_secret}"
 
     def start(self) -> "NodeAgent":
         self._register_node()
@@ -366,15 +387,34 @@ def main(argv=None) -> int:
     parser.add_argument("--log-port", type=int, default=0)
     parser.add_argument("--extra-env", default="",
                         help="JSON object of extra env for every pod")
+    parser.add_argument("--token", default=None,
+                        help="bearer token for the API server (admin "
+                             "role: agents write pod/node state); "
+                             "default $TPU_OPERATOR_TOKEN")
+    parser.add_argument("--token-file", default=None,
+                        help="read the bearer token from this file "
+                             "(first line; wins over --token)")
+    parser.add_argument("--ca-cert", default=None,
+                        help="CA bundle to verify the API server's TLS "
+                             "certificate (self-signed bootstrap)")
+    parser.add_argument("--insecure-skip-tls-verify", action="store_true",
+                        help="skip TLS verification (test/dev only)")
     parser.add_argument("--json-log-format", dest="json_log", default=True,
                         action=argparse.BooleanOptionalAction)
     args = parser.parse_args(argv)
     setup_logging(json_format=args.json_log)
 
+    token = args.token or os.environ.get("TPU_OPERATOR_TOKEN") or None
+    if args.token_file:
+        from tf_operator_tpu.runtime.tlsutil import read_token
+
+        token = read_token(args.token_file)
     extra_env = json.loads(args.extra_env) if args.extra_env else None
     agent = NodeAgent(args.server, name=args.name, address=args.address,
                       chips=args.chips, workdir=args.workdir,
-                      extra_env=extra_env, log_port=args.log_port)
+                      extra_env=extra_env, log_port=args.log_port,
+                      token=token, ca_file=args.ca_cert,
+                      insecure_skip_verify=args.insecure_skip_tls_verify)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())

@@ -27,6 +27,22 @@ Wire contract (all JSON):
 
 Errors: {"reason": NotFound|Conflict|AlreadyExists|BadRequest,
 "message": ...} with status 404/409/409/400.
+
+Security (round 5 — the reference rides the K8s API server, so every
+hop there is TLS + bearer token + RBAC; this server carries its own
+equivalents, runtime/tlsutil.py):
+
+- TLS: pass ``tls_cert``/``tls_key`` (self-signed bootstrap via
+  tlsutil.ensure_self_signed); the url property flips to https.
+- Bearer tokens: pass ``tokens`` ({token: role}); every request except
+  /healthz and /version (liveness probes) must carry
+  ``Authorization: Bearer <token>``. Role ``read-only`` may GET/watch/
+  read logs; writes need ``admin``. Missing/unknown token -> 401,
+  insufficient role -> 403.
+- Fail-closed default: binding a non-loopback address with no tokens
+  configured rejects everything but /healthz//version with 401 unless
+  ``insecure=True`` is passed explicitly (loopback binds stay open for
+  same-host tooling — the kubectl-proxy convention).
 """
 
 from __future__ import annotations
@@ -109,9 +125,39 @@ class _Handler(BaseHTTPRequestHandler):
 
     # Set by APIServer via type():
     store: Store
+    tokens: Optional[Dict[str, str]] = None   # token -> role
+    anonymous_ok: bool = True                 # loopback bind or insecure
 
     def log_message(self, fmt, *args):  # route through logging, not stderr
         log.debug("%s %s", self.address_string(), fmt % args)
+
+    # -- authn/authz -------------------------------------------------------
+
+    _OPEN_PATHS = (("healthz",), ("version",))
+
+    def _authorize(self, parts, write: bool) -> None:
+        """401 unauthenticated / 403 insufficient role. /healthz and
+        /version stay open (liveness probes)."""
+        if tuple(parts) in self._OPEN_PATHS:
+            return
+        if self.tokens is None:
+            if self.anonymous_ok:
+                return
+            raise _ApiError(
+                401, "Unauthorized",
+                "this API server is bound to a non-loopback address "
+                "with no authentication configured; configure bearer "
+                "tokens (--api-tokens-file) or opt out explicitly "
+                "(--api-insecure)")
+        auth = self.headers.get("Authorization", "")
+        token = auth[7:] if auth.startswith("Bearer ") else ""
+        role = self.tokens.get(token)
+        if role is None:
+            raise _ApiError(401, "Unauthorized",
+                            "missing or invalid bearer token")
+        if write and role != "admin":
+            raise _ApiError(403, "Forbidden",
+                            f"role {role!r} may not write")
 
     # -- plumbing ----------------------------------------------------------
 
@@ -124,10 +170,27 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _send_error_obj(self, err: _ApiError) -> None:
+        # An error decided BEFORE the body was read (401/403/404 on a
+        # POST/PUT) must still consume it: HTTP/1.1 keep-alive parses
+        # the next request from wherever this one's bytes ended, and an
+        # unread body would desync the connection into spurious 400s.
+        self._drain_body()
         self._send_json(err.code,
                         {"reason": err.reason, "message": err.message})
 
+    def _drain_body(self) -> None:
+        if getattr(self, "_body_consumed", False):
+            return
+        self._body_consumed = True
+        length = int(self.headers.get("Content-Length", "0") or "0")
+        while length > 0:
+            chunk = self.rfile.read(min(length, 65536))
+            if not chunk:
+                break
+            length -= len(chunk)
+
     def _read_body(self) -> dict:
+        self._body_consumed = True
         length = int(self.headers.get("Content-Length", "0") or "0")
         raw = self.rfile.read(length) if length else b"{}"
         try:
@@ -141,6 +204,9 @@ class _Handler(BaseHTTPRequestHandler):
     def _route(self):
         """(verb-agnostic) parse path -> (kind, cls, ns, name, subresource,
         query) or raise."""
+        # One handler instance serves many keep-alive requests: reset
+        # the per-request body-consumption flag (_drain_body contract).
+        self._body_consumed = False
         parsed = urllib.parse.urlsplit(self.path)
         parts = [p for p in parsed.path.split("/") if p]
         query = urllib.parse.parse_qs(parsed.query)
@@ -164,6 +230,7 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         try:
             parts, query = self._route()
+            self._authorize(parts, write=False)
             if parts == ["healthz"]:
                 return self._send_json(200, {"status": "ok"})
             if parts == ["version"]:
@@ -201,6 +268,7 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         try:
             parts, _ = self._route()
+            self._authorize(parts, write=True)
             if parts[:2] != ["apis", "v1"] or len(parts) != 3:
                 raise _ApiError(404, "NotFound", f"no route {self.path}")
             kind = parts[2]
@@ -218,6 +286,7 @@ class _Handler(BaseHTTPRequestHandler):
     def do_PUT(self):
         try:
             parts, _ = self._route()
+            self._authorize(parts, write=True)
             if parts[:2] != ["apis", "v1"] or len(parts) not in (5, 6):
                 raise _ApiError(404, "NotFound", f"no route {self.path}")
             kind, ns, name = parts[2], parts[3], parts[4]
@@ -239,6 +308,7 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         try:
             parts, _ = self._route()
+            self._authorize(parts, write=True)
             if parts[:2] != ["apis", "v1"] or len(parts) != 5:
                 raise _ApiError(404, "NotFound", f"no route {self.path}")
             kind, ns, name = parts[2], parts[3], parts[4]
@@ -400,14 +470,51 @@ class _Handler(BaseHTTPRequestHandler):
             pass
 
 
+def _is_loopback_host(host: str) -> bool:
+    if host in ("localhost", ""):
+        return True
+    try:
+        import ipaddress
+
+        return ipaddress.ip_address(host).is_loopback
+    except ValueError:
+        return False
+
+
 class APIServer:
-    """Serve a Store over HTTP on a background thread."""
+    """Serve a Store over HTTP(S) on a background thread (see module
+    docstring for the auth/TLS contract)."""
 
     def __init__(self, store: Store, host: str = "127.0.0.1",
-                 port: int = 0):
-        handler = type("BoundHandler", (_Handler,), {"store": store})
+                 port: int = 0,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None,
+                 tokens: Optional[Dict[str, str]] = None,
+                 insecure: bool = False):
+        if bool(tls_cert) != bool(tls_key):
+            raise ValueError("tls_cert and tls_key must be set together")
+        handler = type("BoundHandler", (_Handler,), {
+            "store": store,
+            "tokens": dict(tokens) if tokens else None,
+            "anonymous_ok": insecure or _is_loopback_host(host),
+        })
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
+        self._tls = bool(tls_cert)
+        if tls_cert:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                                 server_side=True)
+        if (tokens is None and not insecure
+                and not _is_loopback_host(host)):
+            log.warning(
+                "API server binding %s with no authentication: all "
+                "requests except /healthz//version will be rejected "
+                "with 401 (configure tokens or pass insecure=True)",
+                host)
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -417,7 +524,8 @@ class APIServer:
     @property
     def url(self) -> str:
         host, port = self._httpd.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     def start(self) -> "APIServer":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -433,15 +541,26 @@ class APIServer:
             self._thread.join(timeout=5)
 
 
-def wait_for_server(url: str, timeout: float = 10.0) -> None:
-    """Block until /healthz answers (process-startup rendezvous)."""
+def wait_for_server(url: str, timeout: float = 10.0,
+                    ca_file: Optional[str] = None) -> None:
+    """Block until /healthz answers (process-startup rendezvous).
+    /healthz is unauthenticated by design; ``ca_file`` verifies a
+    self-signed TLS server."""
+    import ssl
     import time
 
     deadline = time.monotonic() + timeout
     last: Optional[Exception] = None
     while time.monotonic() < deadline:
         try:
-            with urllib.request.urlopen(f"{url}/healthz", timeout=2) as r:
+            ctx = None
+            if url.startswith("https"):
+                # Built inside the loop: with a self-signed bootstrap
+                # the server process writes ca_file at startup, so it
+                # may not exist on the first probes.
+                ctx = ssl.create_default_context(cafile=ca_file)
+            with urllib.request.urlopen(f"{url}/healthz", timeout=2,
+                                        context=ctx) as r:
                 if r.status == 200:
                     return
         except (OSError, socket.timeout) as e:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import logging
+import ssl
 import threading
 import urllib.error
 import urllib.parse
@@ -31,18 +32,44 @@ log = logging.getLogger("tpu_operator.remote")
 _RECONNECT_DELAY = 0.5
 
 
+def _ssl_context(base_url: str, ca_file: Optional[str],
+                 insecure_skip_verify: bool) -> Optional[ssl.SSLContext]:
+    if not base_url.startswith("https"):
+        return None
+    ctx = ssl.create_default_context(cafile=ca_file)
+    if insecure_skip_verify:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    return ctx
+
+
+def _authed(url: str, token: Optional[str],
+            data: Optional[bytes] = None, method: Optional[str] = None,
+            headers: Optional[Dict[str, str]] = None
+            ) -> urllib.request.Request:
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=dict(headers or {}))
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    return req
+
+
 class RemoteWatcher:
     """Store.Watcher analog over a streaming HTTP connection."""
 
     def __init__(self, base_url: str, kind: str,
                  handler: Callable[[str, object], None],
-                 namespace: Optional[str] = None):
+                 namespace: Optional[str] = None,
+                 token: Optional[str] = None,
+                 ssl_context: Optional[ssl.SSLContext] = None):
         self._url = f"{base_url}/apis/v1/watch/{kind}"
         if namespace is not None:
             self._url += "?" + urllib.parse.urlencode(
                 {"namespace": namespace})
         self.kind = kind
         self.handler = handler
+        self._token = token
+        self._ssl = ssl_context
         self._stopped = threading.Event()
         self._resp = None
         self._lock = threading.Lock()
@@ -52,9 +79,28 @@ class RemoteWatcher:
 
     def _loop(self) -> None:
         cls = WIRE_KINDS[self.kind]
+        auth_failures = 0
         while not self._stopped.is_set():
             try:
-                resp = urllib.request.urlopen(self._url)
+                try:
+                    resp = urllib.request.urlopen(
+                        _authed(self._url, self._token), context=self._ssl)
+                except urllib.error.HTTPError as e:
+                    if e.code in (401, 403):
+                        # NOT a transient blip: a misconfigured token
+                        # never fixes itself — surface loudly and back
+                        # off hard so the caller's silent handler is
+                        # explicable from the logs.
+                        auth_failures += 1
+                        if auth_failures == 1 or auth_failures % 60 == 0:
+                            log.warning(
+                                "watch %s rejected with %d (%s): check "
+                                "the bearer token/role; retrying",
+                                self.kind, e.code, e.reason)
+                        self._stopped.wait(5.0)
+                        continue
+                    raise
+                auth_failures = 0
                 with self._lock:
                     if self._stopped.is_set():
                         resp.close()
@@ -103,15 +149,33 @@ class RemoteWatcher:
 
 
 class RemoteStore:
-    """HTTP client with the Store's surface."""
+    """HTTP(S) client with the Store's surface. ``token`` rides every
+    request as a bearer credential; ``ca_file`` verifies a self-signed
+    server (``insecure_skip_verify`` disables verification — test/dev
+    only, the kubeconfig insecure-skip-tls-verify analog)."""
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 token: Optional[str] = None,
+                 ca_file: Optional[str] = None,
+                 insecure_skip_verify: bool = False):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token
+        self._ssl = _ssl_context(self.base_url, ca_file,
+                                 insecure_skip_verify)
         self._watchers: List[RemoteWatcher] = []
         self._lock = threading.Lock()
 
     # -- plumbing ----------------------------------------------------------
+
+    def _open(self, url: str, timeout: Optional[float],
+              data: Optional[bytes] = None,
+              method: Optional[str] = None,
+              headers: Optional[Dict[str, str]] = None):
+        return urllib.request.urlopen(
+            _authed(url, self.token, data=data, method=method,
+                    headers=headers),
+            timeout=timeout, context=self._ssl)
 
     def _request(self, method: str, path: str,
                  body: Optional[dict] = None,
@@ -124,10 +188,9 @@ class RemoteStore:
         if body is not None:
             data = json.dumps(body).encode()
             headers["Content-Type"] = "application/json"
-        req = urllib.request.Request(url, data=data, method=method,
-                                     headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with self._open(url, self.timeout, data=data, method=method,
+                            headers=headers) as resp:
                 return json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:
             payload = {}
@@ -233,7 +296,8 @@ class RemoteStore:
         # The server always replays current objects as ADDED on
         # (re)connect; the replay flag exists for signature parity.
         self._cls(kind)
-        w = RemoteWatcher(self.base_url, kind, handler)
+        w = RemoteWatcher(self.base_url, kind, handler,
+                          token=self.token, ssl_context=self._ssl)
         with self._lock:
             self._watchers.append(w)
         return w
@@ -255,7 +319,7 @@ class RemoteStore:
         if query:
             url += "?" + urllib.parse.urlencode(query)
         try:
-            with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+            with self._open(url, self.timeout) as resp:
                 return resp.read().decode(errors="replace")
         except urllib.error.HTTPError as e:
             if e.code == 404:
@@ -269,7 +333,7 @@ class RemoteStore:
         timeout: a training pod can be quiet for minutes between lines;
         the server closes the stream when the pod terminates."""
         url = (f"{self.base_url}/logs/{namespace}/{pod_name}?follow=1")
-        resp = urllib.request.urlopen(url, timeout=None)
+        resp = self._open(url, None)
         try:
             while True:
                 chunk = resp.read1(65536)

@@ -30,16 +30,30 @@ class TPUJobClient:
 
     @classmethod
     def connect(cls, server_url: str,
-                namespace: str = "default") -> "TPUJobClient":
+                namespace: str = "default",
+                token: Optional[str] = None,
+                ca_file: Optional[str] = None,
+                insecure_skip_verify: bool = False) -> "TPUJobClient":
         """Client against a served control plane (reference: TFJobClient
         building a kubernetes client from kubeconfig and talking HTTPS,
         tf_job_client.py:55-100). Works from any process or host:
 
-            client = TPUJobClient.connect("http://operator-host:8080")
+            client = TPUJobClient.connect(
+                "https://operator-host:8080",
+                token="...", ca_file="/etc/tpu-operator/ca.pem")
+
+        ``token`` is the bearer credential the server's token file
+        grants (admin or read-only); ``ca_file`` verifies a self-signed
+        server certificate. Defaults to $TPU_OPERATOR_TOKEN when unset.
         """
+        import os
+
         from tf_operator_tpu.runtime.remote import RemoteStore
 
-        return cls(RemoteStore(server_url), namespace=namespace)
+        token = token or os.environ.get("TPU_OPERATOR_TOKEN") or None
+        return cls(RemoteStore(server_url, token=token, ca_file=ca_file,
+                               insecure_skip_verify=insecure_skip_verify),
+                   namespace=namespace)
 
     @classmethod
     def connect_kube(cls, kubeconfig: Optional[str] = None,
