@@ -32,6 +32,10 @@ def main() -> int:
                     help="tiny = CPU-smoke-sized model")
     ap.add_argument("--kv-heads", type=int, default=None,
                     help="override n_kv_heads (GQA; default = n_heads)")
+    ap.add_argument("--remat-policy", default="full",
+                    choices=["full", "save_attn", "save_qkv", "mlp_only"],
+                    help="remat granularity (mlp_only keeps attention "
+                         "activations; see LlamaConfig.remat_policy)")
     args = ap.parse_args()
     impl = "" if args.attention == "auto" else args.attention
 
@@ -58,6 +62,7 @@ def main() -> int:
         cfg = LlamaConfig(vocab_size=32768, hidden=1024, n_layers=24,
                           n_heads=16, n_kv_heads=16, head_dim=128,
                           mlp_dim=4096, max_seq_len=args.seq, remat=True,
+                          remat_policy=args.remat_policy,
                           attention_impl=impl)
     if args.kv_heads is not None:
         import dataclasses

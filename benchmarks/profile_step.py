@@ -84,11 +84,19 @@ def parse_trace(path: str, steps: int) -> dict:
     per_op = collections.defaultdict(lambda: [0.0, 0, 0, 0, ""])
     hist = collections.defaultdict(float)
     tot_us = tot_b = tot_f = 0.0
+    wrapper_us = 0.0
     for e in ops:
         a = e.get("args", {})
         b = int(a.get("bytes_accessed", 0))
         fl = int(a.get("model_flops", 0) or 0)
         catname = a.get("hlo_category", "?")
+        # Control-flow wrapper events (scan loops) SPAN their body ops,
+        # which appear as separate events on the same track — counting
+        # both would double the step time (a scanned Llama step showed
+        # +92% from exactly this). Report them separately.
+        if catname in ("while", "conditional"):
+            wrapper_us += e["dur"]
+            continue
         # Async pairs (copy-start/copy-done, async-start/async-done)
         # both carry the full transfer's bytes_accessed — verified:
         # identical values per pair — so only the -done half counts as
@@ -130,6 +138,8 @@ def parse_trace(path: str, steps: int) -> dict:
     return {
         "steps": steps,
         "batch_size": 256,  # capture_trace's config; consumed by bench.py
+        "control_flow_wrapper_ms_per_step": round(
+            wrapper_us / steps / 1000, 2),
         "device_ms_per_step": round(tot_us / steps / 1000, 2),
         "bytes_per_step_gb": round(tot_b / steps / 1e9, 2),
         "model_tflop_per_step": round(tot_f / steps / 1e12, 3),

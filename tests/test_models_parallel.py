@@ -512,5 +512,47 @@ class TestRingFlash:
                                  jnp.zeros((1, 16, 2, 16)),
                                  jnp.zeros((1, 16, 2, 16)))
 
+@pytest.mark.parametrize("policy", ["full", "save_attn", "save_qkv",
+                                    "mlp_only"])
+def test_llama_remat_policies_match_full(policy):
+    """Round-5 remat granularity (LlamaConfig.remat_policy): every
+    policy is a pure scheduling choice — identical param tree, same
+    loss, same grads as whole-block remat. On CPU the flash names
+    don't exist (XLA attention path), so save_attn/save_qkv degrade to
+    full — which is exactly the contract: policies never change math."""
+    mesh = make_mesh(MeshConfig(dp=-1))
+    rng = jax.random.PRNGKey(0)
+    sample = {"inputs": jnp.zeros((8, 33), jnp.int32)}
+    tok = jnp.asarray(np.random.default_rng(2).integers(
+        0, 256, (8, 33)), jnp.int32)
+
+    def loss_and_grads(remat_policy):
+        cfg = dataclasses.replace(llama_tiny(), remat=True,
+                                  remat_policy=remat_policy)
+        _, tr = _llama_trainer(mesh, cfg)
+        state, sh = tr.init(rng, sample)
+        step = tr.make_train_step(sh, sample)
+        new_state, m = step(state, {"inputs": tok})
+        return state.params, float(m["loss"]), new_state.params
+
+    base_tree, base_loss, base_after = loss_and_grads("full")
+    tree, loss, after = loss_and_grads(policy)
+    assert jax.tree.structure(tree) == jax.tree.structure(base_tree)
+    assert loss == pytest.approx(base_loss, rel=1e-5)
+    for a, b in zip(jax.tree.leaves(after), jax.tree.leaves(base_after)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_llama_unknown_remat_policy_rejected():
+    cfg = dataclasses.replace(llama_tiny(), remat=True,
+                              remat_policy="save-attn")  # typo'd value
+    mesh = make_mesh(MeshConfig(dp=-1))
+    _, tr = _llama_trainer(mesh, cfg)
+    with pytest.raises(ValueError, match="remat_policy"):
+        tr.init(jax.random.PRNGKey(0),
+                {"inputs": jnp.zeros((8, 17), jnp.int32)})
+
+
 # CI shard (pyproject [tool.pytest.ini_options] markers)
 pytestmark = pytest.mark.compute

@@ -44,6 +44,27 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Remat granularity (round-5 roofline: the flash kernel's forward
+    # re-executes inside the backward scan under whole-block remat —
+    # profile_llama.py measured it at ~7% of the step):
+    #   "full"     — rematerialize the whole block (lowest memory);
+    #   "save_attn"— remat the whole block but save the flash kernel's
+    #                named outputs (flash_out/flash_lse): the backward
+    #                pass reuses them instead of re-running the kernel
+    #                (~65 MB/layer at the 570M bench shape — fits where
+    #                mlp_only OOMs). The names only exist on the flash
+    #                path: with attention_impl="xla"/"ring" nothing is
+    #                saved and this degrades to "full";
+    #   "save_qkv" — save_attn plus the post-rope q/k/v projections
+    #                (attn_q/k/v, ~96 MB/layer at MHA): the backward
+    #                also skips the QKV matmul + rope recompute;
+    #   "mlp_only" — remat only the MLP branch; the attention branch
+    #                runs un-remat'd so the flash custom-vjp residuals
+    #                (q,k,v,out,lse) persist to the backward pass and
+    #                neither the kernel nor the QKV/rope path is
+    #                recomputed. Costs ~200 MB/layer at the 570M bench
+    #                shape; wins when HBM allows.
+    remat_policy: str = "full"
     # "" = auto (pallas flash on TPU when shapes tile, else XLA);
     # "flash" = force the pallas kernel; "xla" = force the reference;
     # "ring" = einsum ring attention over sp; "ring_flash" = ring with
@@ -86,6 +107,14 @@ class LlamaAttention(nn.Module):
         # ring blocks only materialize inside the shard_map region below).
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
+        # Saveable under remat_policy="save_qkv": keeps the post-rope
+        # projections across the remat boundary so the backward pass
+        # skips the QKV matmuls + rope recompute (no-op otherwise).
+        from jax.ad_checkpoint import checkpoint_name
+
+        q = checkpoint_name(q, "attn_q")
+        k = checkpoint_name(k, "attn_k")
+        v = checkpoint_name(v, "attn_v")
         if cfg.attention_impl in ("ring", "xla"):
             # These paths need full-head KV; the flash kernels (incl.
             # ring_flash) read the shared GQA head directly (no repeated
@@ -172,6 +201,27 @@ class LlamaBlock(nn.Module):
         return x, None
 
 
+class LlamaBlockMlpRemat(nn.Module):
+    """LlamaBlock with remat scoped to the MLP branch only (config
+    remat_policy="mlp_only"): same parameter tree — module names match
+    LlamaBlock's, so param_logical_axes and checkpoints are
+    interchangeable — but the attention branch keeps its activations
+    (incl. the flash kernel's residuals), trading HBM for not running
+    the attention forward twice."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, angles: jax.Array
+                 ) -> Tuple[jax.Array, None]:
+        x = x + LlamaAttention(self.config, name="attn")(
+            RMSNorm(name="attn_norm")(x), angles)
+        mlp = nn.remat(LlamaMLP, prevent_cse=False)
+        x = x + mlp(self.config, name="mlp")(
+            RMSNorm(name="mlp_norm")(x))
+        return x, None
+
+
 class Llama(nn.Module):
     config: LlamaConfig
 
@@ -185,7 +235,22 @@ class Llama(nn.Module):
 
         block = LlamaBlock
         if cfg.remat:
-            block = nn.remat(block, prevent_cse=False)
+            if cfg.remat_policy == "mlp_only":
+                block = LlamaBlockMlpRemat
+            elif cfg.remat_policy in ("save_attn", "save_qkv"):
+                names = ["flash_out", "flash_lse"]
+                if cfg.remat_policy == "save_qkv":
+                    names += ["attn_q", "attn_k", "attn_v"]
+                block = nn.remat(
+                    block, prevent_cse=False,
+                    policy=jax.checkpoint_policies.save_only_these_names(
+                        *names))
+            elif cfg.remat_policy != "full":
+                raise ValueError(
+                    f"unknown remat_policy {cfg.remat_policy!r}; expected "
+                    "full | save_attn | save_qkv | mlp_only")
+            else:
+                block = nn.remat(block, prevent_cse=False)
         ScanBlocks = nn.scan(
             block,
             variable_axes={"params": 0},
