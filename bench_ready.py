@@ -13,17 +13,28 @@ py/kubeflow/tf_operator/tf_job_client.py:116-210). Here a 1-chief +
 4-worker gang (the ResNet-50 BASELINE topology) must reach
 AllReplicasReady in well under a second of controller work.
 
-Prints ONE JSON line:
+Prints ONE JSON line per backend:
     {"metric": ..., "value": N, "unit": "seconds", "vs_baseline": N}
 vs_baseline = (reference implicit SLO lower bound, 600 s) / measured.
+
+Backends (round-5 verdict #5 — both north stars measured per round):
+
+- ``local``: subprocess data plane, the hermetic control loop.
+- ``kube``: the SAME controller against the fake K8s apiserver with
+  injected per-request latency (default 20 ms — a loaded production
+  apiserver) and a fake kubelet that reports Running the moment it
+  observes a pod. This prices the real deployment shape: reflector
+  mirror, pod create round-trips, status patches, watch propagation.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
 import tempfile
+import threading
 import time
 
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
@@ -85,25 +96,114 @@ def measure_once(trial: int, workers: int, chief: int) -> float:
         op.stop()
 
 
-def main() -> int:
-    workers, chief, trials = 4, 1, 3
+def measure_once_kube(trial: int, workers: int, chief: int,
+                      api_latency: float) -> float:
+    """create -> AllReplicasReady against the fake apiserver with
+    injected request latency and an immediate fake kubelet."""
+    from tf_operator_tpu.runtime import store as store_mod
+    from tf_operator_tpu.runtime.kube import (
+        KubeClient,
+        KubeConfig,
+        KubeOperator,
+        tpujob_to_k8s,
+    )
+    from tf_operator_tpu.runtime.kube_fake import FakeKubeApiServer
+
+    fake = FakeKubeApiServer().start()
+    fake.state.latency_seconds = api_latency
+    op = KubeOperator(KubeClient(KubeConfig(server=fake.url)))
+    stop = threading.Event()
+
+    def kubelet() -> None:
+        # The fake kubelet: report Running as soon as a pod appears
+        # (zero container-start cost — the metric prices the CONTROL
+        # PLANE, not image pulls).
+        seen = set()
+        q = fake.state.subscribe("pods")
+        while not stop.is_set():
+            try:
+                etype, obj = q.get(timeout=0.2)
+            except Exception:
+                continue
+            name = obj["metadata"]["name"]
+            if etype == "ADDED" and name not in seen:
+                seen.add(name)
+                try:
+                    fake.state.set_pod_phase("default", name, "Running")
+                except Exception:
+                    pass
+
+    kubelet_t = threading.Thread(target=kubelet, daemon=True)
+    kubelet_t.start()
+    op.start(threadiness=2)
     try:
-        latencies = [measure_once(i, workers, chief) for i in range(trials)]
-        best = min(latencies)
-        print(json.dumps({
-            "metric": f"pod_to_all_replicas_ready_seconds[{chief}c+{workers}w]",
-            "value": round(best, 3),
-            "unit": "seconds",
-            "vs_baseline": round(REFERENCE_SLO_SECONDS / best, 1),
-        }))
-        return 0
-    except Exception as e:
-        print(json.dumps({
-            "metric": "pod_to_all_replicas_ready_seconds",
-            "value": 0.0, "unit": "seconds", "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}",
-        }))
-        return 1
+        job = make_job(f"bench-ready-kube-{trial}", "/tmp", workers, chief)
+        body = tpujob_to_k8s(job)
+        client = op.client
+        t0 = time.monotonic()
+        client.create(store_mod.TPUJOBS, "default", body)
+        deadline = t0 + 120.0
+        while time.monotonic() < deadline:
+            raw = client.get(store_mod.TPUJOBS, "default",
+                             job.metadata.name)
+            if (raw.get("status") or {}).get("allReplicasReadyTime"):
+                return time.monotonic() - t0
+            time.sleep(0.01)
+        raise TimeoutError("AllReplicasReady never latched (kube)")
+    finally:
+        stop.set()
+        op.stop()
+        fake.stop()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default="both",
+                    choices=("local", "kube", "both"))
+    ap.add_argument("--api-latency", type=float, default=0.02,
+                    help="injected per-request apiserver latency for "
+                         "--backend kube (seconds)")
+    ap.add_argument("--trials", type=int, default=3)
+    args = ap.parse_args()
+    workers, chief = 4, 1
+    rc = 0
+    if args.backend in ("local", "both"):
+        try:
+            best = min(measure_once(i, workers, chief)
+                       for i in range(args.trials))
+            print(json.dumps({
+                "metric": (f"pod_to_all_replicas_ready_seconds"
+                           f"[{chief}c+{workers}w]"),
+                "value": round(best, 3),
+                "unit": "seconds",
+                "vs_baseline": round(REFERENCE_SLO_SECONDS / best, 1),
+            }))
+        except Exception as e:
+            print(json.dumps({
+                "metric": "pod_to_all_replicas_ready_seconds",
+                "value": 0.0, "unit": "seconds", "vs_baseline": 0.0,
+                "error": f"{type(e).__name__}: {e}"}))
+            rc = 1
+    if args.backend in ("kube", "both"):
+        try:
+            best = min(measure_once_kube(i, workers, chief,
+                                         args.api_latency)
+                       for i in range(args.trials))
+            print(json.dumps({
+                "metric": (f"pod_to_all_replicas_ready_seconds"
+                           f"[kube,{chief}c+{workers}w,"
+                           f"{int(args.api_latency * 1000)}ms_api]"),
+                "value": round(best, 3),
+                "unit": "seconds",
+                "vs_baseline": round(REFERENCE_SLO_SECONDS / best, 1),
+            }))
+        except Exception as e:
+            print(json.dumps({
+                "metric": "pod_to_all_replicas_ready_seconds[kube]",
+                "value": 0.0, "unit": "seconds", "vs_baseline": 0.0,
+                "error": f"{type(e).__name__}: {e}"}))
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":

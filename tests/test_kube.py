@@ -833,6 +833,232 @@ class TestKubeGangPreemption:
             op.stop()
 
 
+class TestRateLimiting:
+    """Round-5 client-side throttling (reference --kube-api-qps 5 /
+    --kube-api-burst 10, options.go:81-82) + the fake's meanness taps."""
+
+    def test_token_bucket_paces_requests(self, fake):
+        limited = KubeClient(KubeConfig(server=fake.url), qps=50.0,
+                             burst=2)
+        start = time.monotonic()
+        for _ in range(6):
+            limited.list(store_mod.PODS, "default")
+        elapsed = time.monotonic() - start
+        # 2 burst tokens + 4 paced at 50/s >= 80ms of enforced wait.
+        assert elapsed >= 0.07, f"bucket did not pace: {elapsed:.3f}s"
+
+    def test_429_retry_after_honored(self, client, fake):
+        fake.state.retry_after_seconds = 0  # fast test; header honored
+        fake.state.inject_429 = 2
+        assert client.list(store_mod.PODS, "default")["kind"] == "List"
+        assert fake.state.throttled_requests == 2
+        assert fake.state.inject_429 == 0
+
+    def test_429_storm_eventually_surfaces(self, client, fake):
+        from tf_operator_tpu.runtime.kube import KubeApiError
+
+        fake.state.retry_after_seconds = 0
+        fake.state.inject_429 = 50
+        with pytest.raises(KubeApiError) as err:
+            client.list(store_mod.PODS, "default")
+        assert err.value.code == 429
+
+    def test_5xx_surfaces_unretried(self, client, fake):
+        """500s are the reflector's to back off on — the client must
+        not hide them behind silent retries."""
+        from tf_operator_tpu.runtime.kube import KubeApiError
+
+        fake.state.inject_5xx = 1
+        with pytest.raises(KubeApiError) as err:
+            client.list(store_mod.PODS, "default")
+        assert err.value.code == 500
+        assert client.list(store_mod.PODS, "default")["kind"] == "List"
+
+    def test_latency_injection_slows_but_works(self, client, fake):
+        fake.state.latency_seconds = 0.02
+        start = time.monotonic()
+        client.list(store_mod.PODS, "default")
+        assert time.monotonic() - start >= 0.02
+        fake.state.latency_seconds = 0.0
+
+
+class TestThrottledApiserverChaos:
+    def test_gang_preemption_converges_under_throttled_apiserver(
+            self, client, fake):
+        """The round-4 preemption flow with a MEAN apiserver: every
+        request pays injected latency, and 429 bursts hit mid-flow.
+        The operator (QPS-limited like the reference deployment) must
+        still evict the victim and run the preemptor to completion."""
+        fake.state.latency_seconds = 0.01
+        fake.state.retry_after_seconds = 0
+        limited = KubeClient(KubeConfig(server=fake.url), qps=100.0,
+                             burst=20)
+        op = KubeOperator(limited, post_events=False,
+                          enable_gang_scheduling=True, total_chips=8,
+                          gang_preemption=True,
+                          gang_priority_classes={"prod": 100, "batch": 10})
+        op.start(threadiness=1, sync_timeout=15)
+        try:
+            victim = make_job(name="vic", workers=1)
+            victim["spec"]["slice"] = {"accelerator": "v5e-8"}
+            victim["spec"]["runPolicy"] = {"schedulingPolicy": {
+                "minAvailable": 2, "priorityClass": "batch"}}
+            client.create(store_mod.TPUJOBS, "default", victim)
+            wait_for(lambda: fake.state.objects["pods"].get(
+                ("default", "vic-worker-0")), timeout=20,
+                msg="victim pod created under latency")
+            fake.state.set_pod_phase("default", "vic-worker-0", "Running")
+            first_uid = fake.state.objects["pods"][
+                ("default", "vic-worker-0")]["metadata"]["uid"]
+
+            pre = make_job(name="pre", workers=1)
+            pre["spec"]["slice"] = {"accelerator": "v5e-8"}
+            pre["spec"]["runPolicy"] = {"schedulingPolicy": {
+                "priorityClass": "prod"}}
+            client.create(store_mod.TPUJOBS, "default", pre)
+            # 429 burst lands on the OPERATOR's preemption work (after
+            # our own create returned — the test client retries at most
+            # 6 attempts and must not race the injected budget).
+            fake.state.inject_429 = 5
+
+            def evicted():
+                pod = fake.state.objects["pods"].get(
+                    ("default", "vic-worker-0"))
+                return pod and pod["metadata"]["uid"] != first_uid
+            wait_for(evicted, timeout=30,
+                     msg="victim evicted despite 429s + latency")
+
+            wait_for(lambda: fake.state.objects["pods"].get(
+                ("default", "pre-worker-0")), timeout=30,
+                msg="preemptor pod")
+            fake.state.set_pod_phase("default", "pre-worker-0", "Running")
+            fake.state.set_pod_phase("default", "pre-worker-0",
+                                     "Succeeded")
+            wait_for(lambda: any(
+                c["type"] == JobConditionType.SUCCEEDED
+                for c in (client.get(store_mod.TPUJOBS, "default", "pre")
+                          .get("status") or {}).get("conditions") or []),
+                timeout=30, msg="preemptor Succeeded under chaos")
+            assert fake.state.throttled_requests > 0
+        finally:
+            fake.state.latency_seconds = 0.0
+            op.stop()
+
+
+class TestLeaderFailoverDuringPreemption:
+    def test_failover_mid_eviction_converges(self, client, fake):
+        """Two operator replicas, Lease-elected; the leader dies right
+        after preemption starts (victim flipped Pending, deletes in
+        flight). The standby must finish the eviction and place the
+        preemptor with no double-booked chips and no lost eviction —
+        the mid-eviction state is store-derived, not leader memory."""
+        from tf_operator_tpu.runtime.kube import KubeLeaseStore
+        from tf_operator_tpu.runtime.leaderelection import LeaderElector
+
+        fake.state.latency_seconds = 0.005  # widen the in-flight window
+        for i in range(2):
+            fake.state.add_node(f"n{i}", chips=8, ici_domain="dom-a")
+        ops = [KubeOperator(KubeClient(KubeConfig(server=fake.url)),
+                            post_events=False,
+                            enable_gang_scheduling=True,
+                            gang_preemption=True,
+                            gang_priority_classes={"prod": 100,
+                                                   "batch": 10})
+               for _ in range(2)]
+        electors = [
+            LeaderElector(KubeLeaseStore(ops[i].client),
+                          identity=f"op-{i}", lease_duration=2.0,
+                          renew_deadline=0.8, retry_period=0.1,
+                          on_started_leading=(
+                              lambda op=ops[i]: op.start(
+                                  threadiness=1, sync_timeout=15)))
+            for i in range(2)]
+        try:
+            electors[0].start()
+            assert electors[0].wait_until_leading(timeout=10)
+            electors[1].start()
+
+            victim = make_job(name="vic", workers=2)
+            victim["spec"]["slice"] = {"accelerator": "v5e-16"}
+            victim["spec"]["runPolicy"] = {"schedulingPolicy": {
+                "priorityClass": "batch"}}
+            client.create(store_mod.TPUJOBS, "default", victim)
+
+            def victim_bound():
+                pods = [fake.state.objects["pods"].get(
+                    ("default", f"vic-worker-{i}")) for i in range(2)]
+                return all(p and (p["spec"].get("nodeName"))
+                           for p in pods)
+            wait_for(victim_bound, timeout=30, msg="victim bound")
+            fake.state.set_pod_phase("default", "vic-worker-0", "Running")
+            uids = {fake.state.objects["pods"][
+                ("default", f"vic-worker-{i}")]["metadata"]["uid"]
+                for i in range(2)}
+
+            pre = make_job(name="pre", workers=2)
+            pre["spec"]["slice"] = {"accelerator": "v5e-16"}
+            pre["spec"]["runPolicy"] = {"schedulingPolicy": {
+                "priorityClass": "prod"}}
+            client.create(store_mod.TPUJOBS, "default", pre)
+
+            # The instant the victim's group is flipped back to Pending
+            # (preemption decided, deletes possibly in flight), crash
+            # the leader without releasing the lease.
+            def preemption_started():
+                sg = ops[0].store.try_get(store_mod.SLICEGROUPS,
+                                          "default", "vic")
+                return sg is not None and sg.status.phase == "Pending"
+            wait_for(preemption_started, timeout=30,
+                     msg="preemption decided")
+            electors[0]._stop.set()
+            electors[0]._thread.join(timeout=5)
+            ops[0].stop()
+
+            wait_for(lambda: electors[1].is_leader, timeout=15,
+                     msg="standby acquired the lease")
+
+            # Standby completes: victim evicted (fresh uids or gone,
+            # unbound), preemptor bound on distinct nodes.
+            def converged():
+                vic = [fake.state.objects["pods"].get(
+                    ("default", f"vic-worker-{i}")) for i in range(2)]
+                if any(p and p["metadata"]["uid"] in uids for p in vic):
+                    return False  # old victim pod still alive
+                pre_pods = [fake.state.objects["pods"].get(
+                    ("default", f"pre-worker-{i}")) for i in range(2)]
+                return all(p and p["spec"].get("nodeName")
+                           for p in pre_pods)
+            wait_for(converged, timeout=40,
+                     msg="standby finished eviction + placed preemptor")
+
+            # No double-booking: per-node bound chip demand <= capacity.
+            usage = {}
+            for (ns, name), pod in fake.state.objects["pods"].items():
+                node = (pod.get("spec") or {}).get("nodeName")
+                phase = (pod.get("status") or {}).get("phase", "Pending")
+                if not node or phase in ("Succeeded", "Failed"):
+                    continue
+                limits = ((pod["spec"]["containers"][0].get("resources")
+                           or {}).get("limits") or {})
+                usage[node] = usage.get(node, 0) + int(
+                    limits.get(constants.RESOURCE_TPU, 0))
+            assert all(v <= 8 for v in usage.values()), usage
+            # And the victim stayed unbound while gated.
+            for i in range(2):
+                pod = fake.state.objects["pods"].get(
+                    ("default", f"vic-worker-{i}"))
+                assert pod is None or not pod["spec"].get("nodeName")
+        finally:
+            fake.state.latency_seconds = 0.0
+            for e in electors:
+                e.stop()
+            for op in ops:
+                try:
+                    op.stop()
+                except Exception:
+                    pass
+
+
 class TestGangBinderE2E:
     """Self-contained gang scheduling on the kube backend: the operator
     both gates (SliceGroup admission) and BINDS (controller/binder.py)

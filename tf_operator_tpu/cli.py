@@ -171,6 +171,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="kubeconfig path for --backend kube (default: "
                         "in-cluster config when available, else "
                         "$KUBECONFIG or ~/.kube/config)")
+    p.add_argument("--kube-api-qps", type=float, default=5.0,
+                   help="client-side request rate to the K8s API server "
+                        "(reference --kube-api-qps; 0 = unlimited)")
+    p.add_argument("--kube-api-burst", type=int, default=10,
+                   help="token-bucket burst above --kube-api-qps "
+                        "(reference --kube-api-burst)")
     p.add_argument("--resync-period", type=float, default=30.0,
                    help="idle full re-enqueue period in seconds (0 = off)")
     p.add_argument("--leader-elect", default=True,
@@ -214,8 +220,11 @@ class Server:
                 check_crd_exists,
             )
 
+            qps = getattr(args, "kube_api_qps", 5.0)
             client = KubeClient(
-                KubeConfig.resolve(getattr(args, "kubeconfig", None)))
+                KubeConfig.resolve(getattr(args, "kubeconfig", None)),
+                qps=qps if qps and qps > 0 else None,
+                burst=getattr(args, "kube_api_burst", 10))
             if not check_crd_exists(client):
                 # Fail fast like the reference (server.go:124, 232-251).
                 raise RuntimeError(

@@ -38,6 +38,7 @@ import random
 import ssl
 import tempfile
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -229,17 +230,61 @@ def _selector_str(selector: Optional[Dict[str, str]]) -> str:
     return ",".join(f"{k}={v}" for k, v in sorted(selector.items()))
 
 
+class _TokenBucket:
+    """Client-side request rate limiter (reference flags --kube-api-qps 5
+    / --kube-api-burst 10, options.go:81-82; client-go's flowcontrol
+    token bucket). acquire() blocks until a token is available — a hot
+    requeue loop smooths out instead of hammering the API server."""
+
+    def __init__(self, qps: float, burst: int):
+        if qps <= 0:
+            raise ValueError("qps must be positive")
+        self.qps = qps
+        self.burst = max(1, burst)
+        self._tokens = float(self.burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def acquire(self) -> None:
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._tokens = min(self.burst,
+                                   self._tokens + (now - self._last)
+                                   * self.qps)
+                self._last = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return
+                wait = (1.0 - self._tokens) / self.qps
+            time.sleep(wait)
+
+
+# 429 handling: how many Retry-After waits one request will sit out
+# before surfacing the error, and the per-wait cap (a malicious/buggy
+# Retry-After of hours must not hang a reconcile worker).
+_MAX_429_RETRIES = 5
+_MAX_RETRY_AFTER_SECONDS = 30.0
+
+
 class KubeClient:
-    """Minimal typed REST client over the K8s API (stdlib only)."""
+    """Minimal typed REST client over the K8s API (stdlib only).
+
+    ``qps``/``burst`` enable the client-side token bucket (None =
+    unlimited — library default; the operator binary passes the
+    reference's 5/10). Server 429s are honored: the client sleeps the
+    Retry-After (capped) and retries a few times before surfacing."""
 
     def __init__(self, config: KubeConfig, timeout: float = 30.0,
-                 watch_timeout_seconds: float = 300.0):
+                 watch_timeout_seconds: float = 300.0,
+                 qps: Optional[float] = None, burst: int = 10):
         self.config = config
         self.timeout = timeout
         # Server-side watch expiry; a stream that outlives it ends
         # normally and the reflector RESUMES from its last RV (tests
         # shorten this to exercise the resume path).
         self.watch_timeout_seconds = watch_timeout_seconds
+        self._bucket = _TokenBucket(qps, burst) if qps else None
         self._ssl: Optional[ssl.SSLContext] = None
         if config.server.startswith("https"):
             ctx = ssl.create_default_context(
@@ -265,37 +310,54 @@ class KubeClient:
             url += "?" + urllib.parse.urlencode(
                 {k: v for k, v in params.items() if v not in ("", None)})
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
-        if data is not None:
-            req.add_header("Content-Type", content_type)
-        if self.config.token:
-            req.add_header("Authorization", f"Bearer {self.config.token}")
-        try:
-            resp = urllib.request.urlopen(
-                req, timeout=self.timeout if timeout is None else timeout,
-                context=self._ssl)
-        except urllib.error.HTTPError as e:
-            raw = e.read()
+        for attempt in range(_MAX_429_RETRIES + 1):
+            if self._bucket is not None:
+                self._bucket.acquire()
+            req = urllib.request.Request(url, data=data, method=method)
+            req.add_header("Accept", "application/json")
+            if data is not None:
+                req.add_header("Content-Type", content_type)
+            if self.config.token:
+                req.add_header("Authorization",
+                               f"Bearer {self.config.token}")
             try:
-                status = json.loads(raw or b"{}")
-            except json.JSONDecodeError:
-                status = {}
-            reason = status.get("reason", "") or e.reason
-            message = status.get("message", "") or raw.decode(
-                "utf-8", "replace")
-            if e.code == 404:
-                raise store_mod.NotFoundError(message)
-            if e.code == 409 and reason == "AlreadyExists":
-                raise store_mod.AlreadyExistsError(message)
-            if e.code == 409:
-                raise store_mod.ConflictError(message)
-            raise KubeApiError(e.code, reason, message)
-        if stream:
-            return resp
-        with resp:
-            raw = resp.read()
-        return json.loads(raw) if raw else {}
+                resp = urllib.request.urlopen(
+                    req,
+                    timeout=self.timeout if timeout is None else timeout,
+                    context=self._ssl)
+            except urllib.error.HTTPError as e:
+                raw = e.read()
+                try:
+                    status = json.loads(raw or b"{}")
+                except json.JSONDecodeError:
+                    status = {}
+                reason = status.get("reason", "") or e.reason
+                message = status.get("message", "") or raw.decode(
+                    "utf-8", "replace")
+                if e.code == 429 and attempt < _MAX_429_RETRIES:
+                    # Server throttling: honor Retry-After (capped) and
+                    # go again — client-go's standard 429 behavior.
+                    try:
+                        after = float(e.headers.get("Retry-After", "1")
+                                      or "1")
+                    except ValueError:
+                        after = 1.0
+                    metrics.kube_client_throttled.inc()
+                    time.sleep(min(max(after, 0.0),
+                                   _MAX_RETRY_AFTER_SECONDS))
+                    continue
+                if e.code == 404:
+                    raise store_mod.NotFoundError(message)
+                if e.code == 409 and reason == "AlreadyExists":
+                    raise store_mod.AlreadyExistsError(message)
+                if e.code == 409:
+                    raise store_mod.ConflictError(message)
+                raise KubeApiError(e.code, reason, message)
+            if stream:
+                return resp
+            with resp:
+                raw = resp.read()
+            return json.loads(raw) if raw else {}
 
     # -- path builders -----------------------------------------------------
 

@@ -127,6 +127,18 @@ class FakeKubeState:
         # Per-resource list-request counter (watch-resume assertions:
         # proves the reflector did NOT relist).
         self.list_counts: Dict[str, int] = {}
+        # --- round-5 meanness -----------------------------------------
+        # Answer the next N non-watch requests with 429 + Retry-After
+        # (apiserver priority-and-fairness throttling analog).
+        self.inject_429 = 0
+        self.retry_after_seconds = 1
+        self.throttled_requests = 0  # how many 429s were served
+        # Answer the next N non-watch requests with 500 (apiserver
+        # blip / upstream etcd error burst).
+        self.inject_5xx = 0
+        # Fixed added latency per request (models a loaded production
+        # apiserver; tens of ms is realistic).
+        self.latency_seconds = 0.0
 
     def next_rv(self) -> str:
         self._rv += 1
@@ -432,8 +444,48 @@ class _Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as e:
             raise _HttpError(400, "Invalid", f"bad JSON: {e}")
 
+    def _chaos_gate(self) -> bool:
+        """Apply injected latency / 429 / 5xx before routing. Returns
+        True when the request was consumed by an injected error. Watch
+        requests only pay latency (stream-level chaos has its own taps
+        in _serve_watch)."""
+        import time as _time
+
+        is_watch = "watch=1" in self.path or "watch=true" in self.path
+        with self.state.lock:
+            delay = self.state.latency_seconds
+            status = None
+            if not is_watch:
+                if self.state.inject_429 > 0:
+                    self.state.inject_429 -= 1
+                    self.state.throttled_requests += 1
+                    status = 429
+                elif self.state.inject_5xx > 0:
+                    self.state.inject_5xx -= 1
+                    status = 500
+            retry_after = self.state.retry_after_seconds
+        if delay:
+            _time.sleep(delay)
+        if status == 429:
+            body = json.dumps(_status_body(
+                429, "TooManyRequests", "throttled (injected)")).encode()
+            self.send_response(429)
+            self.send_header("Retry-After", str(retry_after))
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return True
+        if status == 500:
+            self._send_json(500, _status_body(
+                500, "InternalError", "injected server error"))
+            return True
+        return False
+
     def _guard(self, fn):
         try:
+            if self._chaos_gate():
+                return
             fn()
         except _HttpError as e:
             try:

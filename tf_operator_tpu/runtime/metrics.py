@@ -268,6 +268,10 @@ gang_pods_bound = REGISTRY.counter(
     "tpu_operator_gang_pods_bound_total",
     "Counts pods the in-operator slice-gang binder bound to nodes",
     ["job_namespace"])
+kube_client_throttled = REGISTRY.counter(
+    "tpu_operator_kube_client_throttled_total",
+    "Counts 429 responses the kube client honored (slept Retry-After "
+    "and retried)")
 is_leader = REGISTRY.gauge(
     "tpu_operator_is_leader",
     "1 while this operator replica holds the leader lease")
