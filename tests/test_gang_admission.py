@@ -745,6 +745,111 @@ def test_e2e_preemption_evicts_running_victim_pods(tmp_path):
         op.stop()
 
 
+def test_preemptor_spawns_only_after_victim_exits(tmp_path):
+    """Round-5 overlap pin (round-4 Weak #6): the victim's store
+    delete precedes its processes' exit by up to the termination grace.
+    The draining gate (LocalProcessBackend.draining_gang_groups wired
+    into the scheduler) must keep the victim's chips counted through
+    that window, so the preemptor's process SPAWNS strictly after the
+    victim's process EXITED — measured with wall-clock markers written
+    by the processes themselves."""
+    import json as _json
+
+    op = Operator.local(workdir=REPO_ROOT, enable_gang_scheduling=True,
+                        total_chips=8, gang_preemption=True,
+                        gang_priority_classes={"prod": 100, "batch": 10})
+    op.start(threadiness=2)
+    try:
+        client = TPUJobClient(op.store)
+        stub_dir = str(tmp_path / "stub")
+
+        # Victim dies SLOWLY: 0.8 s between SIGTERM and actual exit.
+        client.create(gang_job("victim", stub_dir, chips=8,
+                               priority="batch", min_available=2,
+                               args=("--term-grace", "0.8")))
+        wait_for(lambda: any(p.status.phase == "Running"
+                             for p in client.get_pods("victim")),
+                 message="victim running")
+        # Running is written at spawn; wait for the stub to be FULLY up
+        # (env snapshot published => its SIGTERM handler is installed),
+        # or the eviction could kill a half-started interpreter.
+        wait_for(lambda: os.path.exists(os.path.join(
+            stub_dir, "victim-worker-0.env.json")),
+            message="victim stub fully started")
+
+        client.create(gang_job("preemptor", stub_dir, chips=8,
+                               priority="prod",
+                               args=("--exit-after", "0.3")))
+        job = client.wait_for_job("preemptor", timeout=30)
+        assert testutil.check_condition(job, JobConditionType.SUCCEEDED)
+
+        exited_path = os.path.join(stub_dir, "victim-worker-0.exited")
+        assert os.path.exists(exited_path), \
+            "victim never wrote its graceful-exit marker (SIGKILLed?)"
+        with open(exited_path) as f:
+            victim_exit = _json.load(f)["exited_at"]
+        # The preemptor's env snapshot is written at process startup;
+        # its mtime is the spawn-side timestamp on the same clock.
+        spawn_path = os.path.join(stub_dir, "preemptor-worker-0.env.json")
+        preemptor_spawn = os.stat(spawn_path).st_mtime
+        assert preemptor_spawn >= victim_exit, (
+            f"preemptor spawned {victim_exit - preemptor_spawn:.3f}s "
+            "INSIDE the victim's termination-grace window")
+    finally:
+        op.stop()
+
+
+def test_successor_waits_for_deleted_jobs_dying_processes(tmp_path):
+    """The drain gate must also cover plain JOB DELETION (not just
+    preemption): deleting a running gang removes its SliceGroup and
+    re-runs admission instantly, while its processes sit in the
+    termination grace. A queued successor must not spawn until they
+    actually exited — the dying chips stay booked against the global
+    budget via the chip-weighted draining registry."""
+    import json as _json
+
+    op = Operator.local(workdir=REPO_ROOT, enable_gang_scheduling=True,
+                        total_chips=8)
+    op.start(threadiness=2)
+    try:
+        client = TPUJobClient(op.store)
+        stub_dir = str(tmp_path / "stub")
+
+        client.create(gang_job("holder", stub_dir, chips=8,
+                               args=("--term-grace", "0.8")))
+        wait_for(lambda: any(p.status.phase == "Running"
+                             for p in client.get_pods("holder")),
+                 message="holder running")
+        wait_for(lambda: os.path.exists(os.path.join(
+            stub_dir, "holder-worker-0.env.json")),
+            message="holder stub fully started")
+
+        # Successor queued behind the full cluster, then the holder's
+        # JOB is deleted (not preempted).
+        client.create(gang_job("succ", stub_dir, chips=8,
+                               args=("--exit-after", "0.3")))
+        time.sleep(0.3)  # successor visibly gated first
+        assert all(p.status.phase == "Pending"
+                   for p in client.get_pods("succ"))
+        client.delete("holder")
+
+        job = client.wait_for_job("succ", timeout=30)
+        assert testutil.check_condition(job, JobConditionType.SUCCEEDED)
+
+        exited_path = os.path.join(stub_dir, "holder-worker-0.exited")
+        assert os.path.exists(exited_path), \
+            "holder never wrote its graceful-exit marker"
+        with open(exited_path) as f:
+            holder_exit = _json.load(f)["exited_at"]
+        succ_spawn = os.stat(os.path.join(
+            stub_dir, "succ-worker-0.env.json")).st_mtime
+        assert succ_spawn >= holder_exit, (
+            f"successor spawned {holder_exit - succ_spawn:.3f}s inside "
+            "the deleted holder's termination-grace window")
+    finally:
+        op.stop()
+
+
 def test_e2e_no_preemption_flag_means_no_eviction(tmp_path):
     """Without --gang-preemption the high-priority job waits instead of
     evicting (preemption is opt-in, as in Volcano)."""

@@ -129,7 +129,8 @@ class SliceGangScheduler(GangScheduler):
                  pod_control=None,
                  scheduled_pods_occupy: bool = False,
                  capacity_provider=None,
-                 domain_capacity_provider=None):
+                 domain_capacity_provider=None,
+                 draining_provider=None):
         if fairness not in ("backfill", "strict", "aged"):
             raise ValueError(f"unknown gang fairness {fairness!r}")
         self.store = store
@@ -146,6 +147,14 @@ class SliceGangScheduler(GangScheduler):
         # instead of booking budget forever (kube backend binds this to
         # node inventory; None = no topology knowledge, aggregate only).
         self.domain_capacity_provider = domain_capacity_provider
+        # Optional data-plane drain feedback: (ns, group) -> count of
+        # deleted pods whose PROCESSES are still dying. Their chips
+        # stay counted so a preemptor is never admitted into a victim's
+        # termination-grace window (the local backend binds
+        # LocalProcessBackend.draining_gang_groups here; kubelet has
+        # the same window on the kube backend, where
+        # scheduled_pods_occupy + the pod object's lifetime covers it).
+        self.draining_provider = draining_provider
         self.fairness = fairness
         self.aging_seconds = aging_seconds
         self.priority_classes = dict(priority_classes or {})
@@ -363,6 +372,18 @@ class SliceGangScheduler(GangScheduler):
                     used += c
                     q = g.spec.queue or ""
                     queue_used[q] = queue_used.get(q, 0) + c
+            # Chips held by dying processes of groups that no longer
+            # EXIST (job deleted mid-run: delete_slice_group removed
+            # the SliceGroup and re-ran admission while the processes
+            # sit in their termination grace). They stay booked against
+            # the global budget until the data plane reports them gone
+            # — drain completion pokes readmit — so a queued successor
+            # never overlaps them. Queue quotas can't be charged (the
+            # queue died with the group); global accounting suffices
+            # because quotas only subdivide the global budget.
+            for dk, d in self._draining().items():
+                if dk not in live_keys:
+                    used += d.get("chips", 0)
             # Per-queue lane blocking: queue -> minimum priority still
             # allowed to backfill (None = hard block, nothing admits).
             blocked: Dict[str, Optional[int]] = {}
@@ -473,12 +494,12 @@ class SliceGangScheduler(GangScheduler):
         # lock. Completed evictions free their chips on the next pass
         # (triggered by the pods' DELETED events re-enqueuing jobs);
         # failed deletes are retried because the next pass re-derives
-        # the same group from its still-occupying pods. Local-backend
-        # caveat: the store delete precedes process SIGTERM by up to the
-        # termination grace (~3s), so a preemptor admitted on the next
-        # pass can briefly overlap the dying processes — the same
-        # overlap kubelet's grace period produces; chip *accounting*
-        # converges either way.
+        # the same group from its still-occupying pods. On the local
+        # backend the store delete precedes process exit by up to the
+        # termination grace (~3s); draining_provider keeps those chips
+        # counted until the processes actually exit, so a preemptor is
+        # never admitted into the dying window (round-5; pinned by
+        # test_preemptor_spawns_only_after_victim_exits).
         for ns, name in to_evict:
             self._evict_pods(ns, name)
 
@@ -585,7 +606,8 @@ class SliceGangScheduler(GangScheduler):
             # the snapshot and this flip, and freeing its chips off the
             # stale snapshot would admit the preemptor into the spawn
             # window.
-            if self._pods_occupying(*vk):
+            if (self._pods_occupying(*vk)
+                    or self._draining().get(vk, {}).get("pods", 0)):
                 to_evict.append(vk)
             else:
                 c = _chips_for(v)
@@ -622,7 +644,9 @@ class SliceGangScheduler(GangScheduler):
         """(namespace, group) -> occupying-pod count, from ONE
         deepcopy-free pod-store projection — the per-pass probe must
         not do a full list per Pending group under the scheduler
-        lock."""
+        lock. Dying-but-not-exited local processes (draining_provider)
+        occupy too: the store delete alone must not hand their chips
+        to a preemptor."""
         index: Dict[tuple, int] = {}
 
         def key_of(p):
@@ -633,7 +657,20 @@ class SliceGangScheduler(GangScheduler):
 
         for k in self.store.project(store_mod.PODS, key_of):
             index[k] = index.get(k, 0) + 1
+        for k, d in self._draining().items():
+            index[k] = index.get(k, 0) + d.get("pods", 0)
         return index
+
+    def _draining(self) -> Dict[tuple, Dict[str, int]]:
+        """(ns, group) -> {"pods": live processes, "chips": chips they
+        hold}, from the data plane (empty without a provider)."""
+        if self.draining_provider is None:
+            return {}
+        try:
+            return dict(self.draining_provider())
+        except Exception:
+            log.debug("draining_provider failed", exc_info=True)
+            return {}
 
     def _evict_pods(self, ns: str, name: str) -> None:
         """Delete a preempted group's Running pods (Volcano evicts pods;

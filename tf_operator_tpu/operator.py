@@ -62,6 +62,13 @@ class Operator:
                                            namespace=namespace)
         self.backend = (LocalProcessBackend(self.store)
                         if backend is _DEFAULT_BACKEND else backend)
+        if gang is not None and hasattr(self.backend,
+                                        "draining_gang_groups"):
+            # Close the preemption overlap window: chips of deleted
+            # pods stay counted until their processes exit, and drain
+            # completion re-runs admission immediately.
+            gang.draining_provider = self.backend.draining_gang_groups
+            self.backend.on_gang_drained = gang.readmit
 
     def start(self, threadiness: int = 2) -> None:
         if self.backend is not None:

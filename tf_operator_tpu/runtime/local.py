@@ -58,6 +58,9 @@ class _RunningPod:
     restart_counts: Dict[str, int] = field(default_factory=dict)
     stop_requested: bool = False
     done: bool = False
+    # True while this pod's processes are counted in the backend's
+    # gang-occupancy registry (see _gang_acquire/_gang_release).
+    gang_held: bool = False
 
 
 class LoopbackEnvResolver:
@@ -150,6 +153,23 @@ class LocalProcessBackend:
         self._running: Dict[str, _RunningPod] = {}  # "ns/name" -> state
         self._watcher = None
         self._stopped = False
+        # Gang groups with LIVE local processes: (ns, group) -> chips
+        # held (sum of the spawned pods' google.com/tpu requests).
+        # Registered synchronously at spawn and released only after
+        # process exit, so the gang scheduler's draining_provider sees
+        # the chips as occupied through the whole process lifetime —
+        # including the termination-grace window after the store pod
+        # (or even the whole SliceGroup, on job deletion) is already
+        # gone (round-4 Weak #6: the store delete alone opened an
+        # up-to-_GRACE_SECONDS overlap where a successor could run
+        # alongside dying victims). Value = [pod count, chips] so the
+        # scheduler can both gate occupancy and keep budget booked for
+        # groups that no longer exist.
+        self._gang_procs: Dict[tuple, list] = {}
+        # Called (if set) when a gang group's last dying process exits,
+        # so admission re-runs immediately instead of at the next
+        # resync (process exit writes no store event to ride).
+        self.on_gang_drained = None
 
     # ------------------------------------------------------------------
 
@@ -212,6 +232,11 @@ class LocalProcessBackend:
             log.warning("pod %s failed to start: %s", key, e)
             self._write_status(pod, PodPhase.FAILED, message=str(e))
             return
+        # Chips are held from the first spawned process until the last
+        # one EXITS (not until the store pod is deleted) — the gang
+        # scheduler reads this registry to close the preemption
+        # overlap window.
+        self._gang_acquire(rp)
         if rp.stop_requested:
             # Deletion raced the spawn: _terminate saw an empty process
             # map, so these processes would otherwise leak.
@@ -336,6 +361,9 @@ class LocalProcessBackend:
                     try:
                         self._spawn_all(rp)
                     except Exception as e:
+                        # All processes are dead: the chips must not
+                        # stay booked behind a failed respawn.
+                        self._gang_release(rp)
                         self._write_status(pod, PodPhase.FAILED, message=str(e))
                         return
                     self._write_running(rp)
@@ -344,30 +372,93 @@ class LocalProcessBackend:
                 phase = (PodPhase.SUCCEEDED
                          if all(c == 0 for c in exited.values())
                          else PodPhase.FAILED)
+                self._gang_release(rp)  # natural death frees the chips
                 self._write_status(pod, phase, exit_codes=exited, rp=rp)
                 return
             time.sleep(0.02)
 
+    def draining_gang_groups(self) -> Dict[tuple, Dict[str, int]]:
+        """(namespace, gang group) -> {"pods": live-process pod count,
+        "chips": chips those pods hold}. Consumed by the gang
+        scheduler's draining_provider so freed chips only admit a
+        successor after the previous holders actually exited — even
+        when the holder's SliceGroup itself was deleted with its job
+        (pods gates occupancy; chips keeps deleted groups' budget
+        booked)."""
+        with self._lock:
+            return {k: {"pods": v[0], "chips": v[1]}
+                    for k, v in self._gang_procs.items()}
+
+    def _gang_key(self, pod: Pod):
+        from tf_operator_tpu.api import constants
+
+        group = pod.metadata.annotations.get(
+            constants.ANNOTATION_GANG_GROUP, "")
+        return (pod.metadata.namespace, group) if group else None
+
+    @staticmethod
+    def _pod_chips(pod: Pod) -> int:
+        from tf_operator_tpu.controller.binder import pod_chip_demand
+
+        return pod_chip_demand(pod)
+
+    def _gang_acquire(self, rp: _RunningPod) -> None:
+        key = self._gang_key(rp.pod)
+        if key is None:
+            return
+        with self._lock:
+            if rp.gang_held:
+                return
+            rp.gang_held = True
+            entry = self._gang_procs.setdefault(key, [0, 0])
+            entry[0] += 1
+            entry[1] += self._pod_chips(rp.pod)
+
+    def _gang_release(self, rp: _RunningPod) -> None:
+        key = self._gang_key(rp.pod)
+        if key is None:
+            return
+        with self._lock:
+            if not rp.gang_held:
+                return
+            rp.gang_held = False
+            entry = self._gang_procs.get(key, [1, 0])
+            entry[0] -= 1
+            entry[1] = max(0, entry[1] - self._pod_chips(rp.pod))
+            left = entry[0]
+            if left <= 0:
+                self._gang_procs.pop(key, None)
+        if left <= 0 and self.on_gang_drained is not None:
+            # Process exit writes no store event; poke admission so the
+            # waiting successor lands now, not at the next resync.
+            try:
+                self.on_gang_drained()
+            except Exception:
+                log.debug("on_gang_drained failed", exc_info=True)
+
     def _terminate(self, rp: _RunningPod) -> None:
         rp.stop_requested = True
-        procs = list(rp.processes.values())
-        for proc in procs:
-            if proc.poll() is None:
+        try:
+            procs = list(rp.processes.values())
+            for proc in procs:
+                if proc.poll() is None:
+                    try:
+                        os.killpg(proc.pid, signal.SIGTERM)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+            deadline = time.monotonic() + _GRACE_SECONDS
+            for proc in procs:
+                remaining = deadline - time.monotonic()
                 try:
-                    os.killpg(proc.pid, signal.SIGTERM)
-                except (ProcessLookupError, PermissionError):
-                    pass
-        deadline = time.monotonic() + _GRACE_SECONDS
-        for proc in procs:
-            remaining = deadline - time.monotonic()
-            try:
-                proc.wait(timeout=max(0.05, remaining))
-            except subprocess.TimeoutExpired:
-                try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    pass
-                proc.wait(timeout=5)
+                    proc.wait(timeout=max(0.05, remaining))
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+                    proc.wait(timeout=5)
+        finally:
+            self._gang_release(rp)
 
     # ------------------------------------------------------------------
 

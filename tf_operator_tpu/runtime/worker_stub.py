@@ -46,10 +46,26 @@ def main(argv=None) -> int:
                         help="exit autonomously after this many seconds")
     parser.add_argument("--exit-code", type=int, default=0)
     parser.add_argument("--poll-interval", type=float, default=0.05)
+    parser.add_argument("--term-grace", type=float, default=None,
+                        help="handle SIGTERM gracefully: keep running "
+                             "this many seconds, then write "
+                             "{pod}.exited (with a timestamp) and exit "
+                             "0 — models a slow-dying worker for "
+                             "preemption-overlap tests")
     args = parser.parse_args(argv)
 
     stub_dir = os.environ.get("TPUJOB_STUB_DIR", "")
     pod_name = os.environ.get("TPUJOB_POD_NAME", f"pid-{os.getpid()}")
+
+    # Install the graceful-term handler BEFORE publishing the env
+    # snapshot: tests use the snapshot's existence as "stub fully
+    # started", so a SIGTERM arriving after it must always be caught.
+    term_at = []
+    if args.term_grace is not None:
+        import signal
+
+        signal.signal(signal.SIGTERM,
+                      lambda *_: term_at.append(time.monotonic()))
     # Identity banner on stdout: exercised by the log-capture path
     # (reference test-server logs requests the same way).
     print(f"worker stub {pod_name} started", flush=True)
@@ -71,6 +87,15 @@ def main(argv=None) -> int:
     deadline = (time.monotonic() + args.exit_after
                 if args.exit_after is not None else None)
     while True:
+        if term_at and time.monotonic() - term_at[0] >= args.term_grace:
+            # Slow graceful death complete: publish the exit instant
+            # (wall clock — tests compare against other processes).
+            if stub_dir:
+                path = os.path.join(stub_dir, f"{pod_name}.exited")
+                with open(path + ".tmp", "w") as f:
+                    json.dump({"exited_at": time.time()}, f)
+                os.replace(path + ".tmp", path)
+            return 0
         if cmd_path and os.path.exists(cmd_path):
             with open(cmd_path) as f:
                 line = f.read().strip()
