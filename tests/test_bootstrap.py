@@ -282,5 +282,55 @@ def test_validation_warnings_multislice_shape():
     ok.spec.slice.num_slices = 2
     assert validation_warnings(ok) == []
 
+def test_multislice_resize_rerenders_megascale_env():
+    """Round-5 multislice elasticity golden: resizing numSlices (the
+    dcn axis) re-renders the per-slice MEGASCALE env for the new world
+    — slice membership, per-slice coordinators, and slice count all
+    follow the resize — and the bootstrap digest changes for every
+    worker (dense AND sparse-elastic: MEGASCALE_NUM_SLICES is a world
+    fact even sparse workers join), so the engine world-restarts them
+    onto the new slicing (reference enableDynamicWorker taken to the
+    multislice case, types.go:66-67)."""
+    from tf_operator_tpu.controller.tpu_controller import (
+        TPUJobController,
+    )
+    from tf_operator_tpu.runtime.store import Store
+
+    plugin = TPUJobController(Store())
+
+    def job_with_slices(n_slices, workers):
+        job = make_job(worker=workers)
+        job.spec.slice.accelerator = "v5e-16"  # 2 hosts per slice
+        job.spec.slice.num_slices = n_slices
+        return job
+
+    before = job_with_slices(2, 4)
+    after = job_with_slices(4, 8)
+
+    env_b = render_worker_env(before, "worker", 3, domain="")
+    assert env_b["MEGASCALE_NUM_SLICES"] == "2"
+    assert env_b["MEGASCALE_SLICE_ID"] == "1"
+    env_a = render_worker_env(after, "worker", 3, domain="")
+    assert env_a["MEGASCALE_NUM_SLICES"] == "4"
+    assert env_a["MEGASCALE_SLICE_ID"] == "1"
+    # Worker 6 lands in a slice that did not exist before the resize,
+    # with a per-slice coordinator rendered for the new world.
+    env_new = render_worker_env(after, "worker", 6, domain="")
+    assert env_new["MEGASCALE_SLICE_ID"] == "3"
+    assert env_new["MEGASCALE_SLICE_COORDINATOR"].startswith(
+        "test-cluster-spec-worker-6.")
+    assert env_new["JAX_NUM_PROCESSES"] == "8"
+
+    # Digest flip drives the engine's restart-from-checkpoint path.
+    assert (plugin.bootstrap_hash(before, "worker", 0)
+            != plugin.bootstrap_hash(after, "worker", 0))
+    # Sparse-elastic workers restart too: the slice count is part of
+    # the world they rendezvous with over DCN.
+    before.spec.enable_elastic_worker = True
+    after.spec.enable_elastic_worker = True
+    assert (plugin.bootstrap_hash(before, "worker", 0)
+            != plugin.bootstrap_hash(after, "worker", 0))
+
+
 # CI shard (pyproject [tool.pytest.ini_options] markers)
 pytestmark = pytest.mark.control_plane
