@@ -526,6 +526,17 @@ class TPUJobController(JobPlugin):
             d.pop("task", None)
             if sparse:
                 (d.get("cluster") or {}).pop(ReplicaType.WORKER, None)
+            if rt in (ReplicaType.PS, ReplicaType.EVALUATOR):
+                # Non-data-plane roles never DIAL the jax world through
+                # the spec (ps serves, workers dial it; bootstrap
+                # renders them no JAX_* env) — so a worker/chief resize
+                # must not restart them: a ps restart interrupts the
+                # whole job's parameter serving for nothing. Their
+                # digest keeps the entries peers reach THEM by (their
+                # own role list) and drops the data-plane lists.
+                for t in (ReplicaType.CHIEF, ReplicaType.MASTER,
+                          ReplicaType.WORKER):
+                    (d.get("cluster") or {}).pop(t, None)
             env["TPUJOB_CLUSTER_SPEC"] = _json.dumps(d, sort_keys=True)
         if sparse:
             env.pop("JAX_NUM_PROCESSES", None)
