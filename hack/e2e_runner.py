@@ -33,6 +33,9 @@ DEFAULT_SUITES = [
     "tests/test_bootstrap.py",
     "tests/test_gang_admission.py",
     "tests/test_ps.py",
+    # Round 5: binder placement + served-plane auth/TLS units.
+    "tests/test_binder.py",
+    "tests/test_apiserver.py",
 ]
 
 

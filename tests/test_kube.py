@@ -1111,7 +1111,7 @@ class TestGangBinderE2E:
             def victim_bound():
                 nodes = [self._node_of(fake, "default", f"vic-worker-{i}")
                          for i in range(2)]
-                return all(nodes) and nodes or None
+                return nodes if all(nodes) else None
             nodes = wait_for(victim_bound, timeout=20,
                              msg="victim workers bound")
             assert len({n.rsplit("-n", 1)[0] for n in nodes}) == 1, \
@@ -1150,7 +1150,7 @@ class TestGangBinderE2E:
             def pre_bound():
                 nodes = [self._node_of(fake, "default", f"pre-worker-{i}")
                          for i in range(4)]
-                return all(nodes) and nodes or None
+                return nodes if all(nodes) else None
             nodes = wait_for(pre_bound, timeout=20,
                              msg="preemptor workers bound")
             doms = [n.rsplit("-n", 1)[0] for n in nodes]
@@ -1205,6 +1205,42 @@ class TestGangBinderE2E:
             assert sg is not None and sg.status.phase == "Pending"
             assert not self._node_of(fake, "default", "big-worker-0")
         finally:
+            op.stop()
+
+    def test_binder_converges_under_throttled_apiserver(self, client,
+                                                        fake):
+        """The self-contained bind path under a MEAN apiserver: every
+        request pays latency and a 429 burst lands mid-flow; admission
+        (node-derived capacity) and binding still converge with the
+        slice whole in one domain."""
+        fake.state.latency_seconds = 0.01
+        fake.state.retry_after_seconds = 0
+        for dom in ("dom-a", "dom-b"):
+            for i in range(2):
+                fake.state.add_node(f"{dom}-n{i}", chips=8,
+                                    ici_domain=dom)
+        limited = KubeClient(KubeConfig(server=fake.url), qps=100.0,
+                             burst=20)
+        op = KubeOperator(limited, post_events=False,
+                          enable_gang_scheduling=True)
+        op.start(threadiness=1, sync_timeout=15)
+        try:
+            raw = make_job(name="cj", workers=2)
+            raw["spec"]["slice"] = {"accelerator": "v5e-16"}
+            client.create(store_mod.TPUJOBS, "default", raw)
+            fake.state.inject_429 = 5  # lands on the operator's work
+
+            def bound():
+                nodes = [self._node_of(fake, "default",
+                                       f"cj-worker-{i}")
+                         for i in range(2)]
+                return nodes if all(nodes) else None
+            nodes = wait_for(bound, timeout=30,
+                             msg="gang bound under 429s + latency")
+            assert len({n.rsplit("-n", 1)[0] for n in nodes}) == 1
+            assert fake.state.throttled_requests > 0
+        finally:
+            fake.state.latency_seconds = 0.0
             op.stop()
 
     def test_capacity_follows_cordon(self, client, fake):
