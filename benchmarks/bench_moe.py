@@ -1,0 +1,108 @@
+"""Mixtral-style MoE training throughput (round 5 — the last model
+family without a measured number).
+
+A mid-size MoE decoder (8 experts, top-2, GShard capacity dispatch) on
+one chip: ep=1 collapses the all-to-alls, but the dispatch/combine
+einsums, router, capacity dropping, and aux loss all run exactly as in
+the sharded path, so this prices the MoE machinery itself. Model MFU
+counts ACTIVE parameters only (attention + top-k of the expert stack)
+— the MoE selling point is exactly that inactive experts cost no
+FLOPs, so counting them would flatter the number.
+
+    python benchmarks/bench_moe.py [--batch 8] [--seq 2048]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import timing  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    ap.add_argument("--preset", default="512m", choices=["512m", "tiny"],
+                    help="tiny = CPU-smoke-sized model")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tf_operator_tpu.models.mixtral import (
+        Mixtral,
+        MixtralConfig,
+        make_moe_lm_loss,
+        param_logical_axes,
+    )
+    from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh, use_mesh
+    from tf_operator_tpu.parallel.sharding import MOE_RULES
+    from tf_operator_tpu.train.trainer import Trainer
+
+    if args.preset == "tiny":
+        cfg = MixtralConfig(vocab_size=512, hidden=128, n_layers=2,
+                            n_heads=4, n_kv_heads=2, head_dim=32,
+                            mlp_dim=256, n_experts=4, experts_per_token=2,
+                            max_seq_len=args.seq, remat=False,
+                            rope_theta=10000.0)
+    else:
+        cfg = MixtralConfig(vocab_size=32768, hidden=1024, n_layers=8,
+                            n_heads=16, n_kv_heads=4, head_dim=128,
+                            mlp_dim=2048, n_experts=8, experts_per_token=2,
+                            max_seq_len=args.seq, remat=True)
+    B, S = args.batch, args.seq
+    mesh = make_mesh(MeshConfig(dp=-1))
+    # make_moe_lm_loss attaches its own model_inputs_fn; Trainer
+    # auto-detects it.
+    trainer = Trainer(model=Mixtral(cfg), param_axes_fn=param_logical_axes,
+                      rules=MOE_RULES, mesh=mesh,
+                      optimizer=optax.adamw(1e-4),
+                      loss_fn=make_moe_lm_loss(cfg.aux_loss_weight))
+    rng = jax.random.PRNGKey(0)
+    sample = {"inputs": jnp.zeros((B, S + 1), jnp.int32)}
+    with use_mesh(mesh):
+        state, sh = trainer.init(rng, sample)
+        step = trainer.make_train_step(sh, sample)
+        tok = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (B, S + 1)), jnp.int32)
+        for _ in range(3):
+            state, m = step(state, {"inputs": tok})
+        float(m["loss"])  # host sync (block_until_ready lies on axon)
+        dt, dt_single, state = timing.timed_two_block_stateful(
+            step, state, {"inputs": tok}, args.steps)
+
+    nparams = sum(x.size for x in jax.tree.leaves(state.params))
+    # Active params: experts contribute k/E of their weights per token.
+    expert_params = 3 * cfg.hidden * cfg.mlp_dim * cfg.n_experts \
+        * cfg.n_layers
+    active = nparams - expert_params * (
+        1 - cfg.experts_per_token / cfg.n_experts)
+    attn_fl = 3.5 * 4 * cfg.n_layers * cfg.n_heads * S * S \
+        * cfg.head_dim / 2 * B
+    flops = 6 * active * B * S + attn_fl
+    print(json.dumps({
+        "what": f"mixtral{nparams // 1_000_000}m_moe_train[top"
+                f"{cfg.experts_per_token}of{cfg.n_experts}]",
+        "ms_per_step": round(dt * 1e3, 1),
+        "ms_per_step_single_block": round(dt_single * 1e3, 1),
+        "tokens_per_sec": round(B * S / dt),
+        "params_total": nparams,
+        "params_active": int(active),
+        "model_mfu_active": round(flops / dt / (args.peak_tflops * 1e12),
+                                  3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
