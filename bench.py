@@ -28,6 +28,59 @@ PEAK_FLOPS = {
 # ResNet-50 @224: ~4.09 GFLOP forward per image; train step ~3x forward.
 RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.09e9
 
+# Outlier-rep guard (round-5 verdict: BENCH_r05.json shipped a 238 img/s
+# rep against a 2,610 best — a tunnel stall mid-rep — with spread_frac
+# 0.91; one more bad rep would have flipped the median the whole
+# round-over-round series rests on). When the rep spread exceeds the
+# threshold, run up to MAX_EXTRA_REPS replacements and report the median
+# of the stable set, recording every discarded rep + cause in the
+# artifact.
+SPREAD_THRESHOLD = 0.1
+MAX_EXTRA_REPS = 2
+
+
+def _spread_frac(values) -> float:
+    s = sorted(values)
+    median = s[len(s) // 2]
+    return (s[-1] - s[0]) / median if median else 0.0
+
+
+def _stablest_subset(times, k):
+    """Indices of the k-member subset with the smallest spread — the
+    'stable set'. n stays <= base+extra (5), so brute force is fine."""
+    import itertools
+
+    return min(itertools.combinations(range(len(times)), k),
+               key=lambda idx: _spread_frac([times[i] for i in idx]))
+
+
+def collect_reps(run_block, base_reps: int = 3,
+                 spread_threshold: float = SPREAD_THRESHOLD,
+                 max_extra: int = MAX_EXTRA_REPS):
+    """Run ``run_block`` (-> seconds per timed block) ``base_reps``
+    times; while no ``base_reps``-sized subset of the reps agrees
+    within ``spread_threshold``, run one extra rep (up to
+    ``max_extra``). Report the stablest subset — stalled reps (in
+    either direction) are replaced instead of corrupting the reported
+    median, and majority-stall rounds still converge once enough clean
+    reps exist. Returns (kept_times, discarded) where ``discarded`` is
+    [{"seconds", "cause"}, ...] for the artifact. The stable set keeps
+    ``base_reps`` members, so the reported stat stays a median-of-3
+    comparable round over round."""
+    times = [run_block() for _ in range(base_reps)]
+    for _ in range(max_extra):
+        kept = _stablest_subset(times, base_reps)
+        if _spread_frac([times[i] for i in kept]) <= spread_threshold:
+            break
+        times.append(run_block())
+    kept = set(_stablest_subset(times, base_reps))
+    discarded = [
+        {"seconds": round(times[i], 6),
+         "cause": f"spread_frac>{spread_threshold} (outlier rep; "
+                  "host/tunnel stall suspected)"}
+        for i in range(len(times)) if i not in kept]
+    return [times[i] for i in sorted(kept)], discarded
+
 
 def detect_chip() -> str:
     import jax
@@ -99,13 +152,19 @@ def bench_resnet50(batch_size: int, image_size: int, steps: int,
     # Median of >=3 timed repetitions with reported spread: max-of-n
     # flatters one lucky run; the median is robust to one-off host or
     # tunnel hiccups in both directions and comparable round over round.
-    times = []
-    for _ in range(3):
+    # collect_reps replaces outlier reps (spread_frac > threshold) with
+    # re-runs so one mid-rep stall cannot flip the median.
+    state_box = [state]
+
+    def run_block() -> float:
         t0 = time.perf_counter()
         for _ in range(steps):
-            state, metrics = step(state, batch)
-        float(metrics["loss"])
-        times.append(time.perf_counter() - t0)
+            state_box[0], m = step(state_box[0], batch)
+        float(m["loss"])
+        return time.perf_counter() - t0
+
+    times, discarded = collect_reps(run_block)
+    state = state_box[0]
     rates = sorted(batch_size * steps / dt for dt in times)
     median = rates[len(rates) // 2]
     spread = (rates[-1] - rates[0]) / median if median else 0.0
@@ -129,6 +188,7 @@ def bench_resnet50(batch_size: int, image_size: int, steps: int,
     corrected = batch_size / per_step if per_step > 0 else None
     return median, {"best": rates[-1], "worst": rates[0],
                     "spread_frac": round(spread, 4), "reps": len(rates),
+                    "discarded_reps": discarded,
                     "sync_corrected": (round(corrected, 2)
                                        if corrected else None)}
 

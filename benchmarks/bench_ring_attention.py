@@ -60,6 +60,7 @@ def run_chip():
     import jax
     import jax.numpy as jnp
 
+    from tf_operator_tpu.compat import shard_map
     from tf_operator_tpu.ops.flash_attention import best_attention
     from tf_operator_tpu.ops.ring_attention import ring_attention
     from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh
@@ -73,7 +74,7 @@ def run_chip():
                    for kk in ks)
 
         def ring1(q, k, v):
-            fn = jax.shard_map(
+            fn = shard_map(
                 lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
                 mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
                 check_vma=False)
@@ -82,7 +83,7 @@ def run_chip():
         from tf_operator_tpu.ops.ring_attention import ring_flash_attention
 
         def ringf1(q, k, v):
-            fn = jax.shard_map(
+            fn = shard_map(
                 lambda q, k, v: ring_flash_attention(q, k, v,
                                                      axis_name="sp"),
                 mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),

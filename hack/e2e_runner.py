@@ -36,6 +36,9 @@ DEFAULT_SUITES = [
     # Round 5: binder placement + served-plane auth/TLS units.
     "tests/test_binder.py",
     "tests/test_apiserver.py",
+    # Round 6: slice-health & auto-repair (maintenance-aware node
+    # lifecycle with gang drain/rebind).
+    "tests/test_health.py",
 ]
 
 

@@ -456,6 +456,50 @@ class TestAuthAndTls:
             server.stop()
             store.stop_watchers()
 
+    def test_empty_host_bind_all_fails_closed(self):
+        """host='' makes ThreadingHTTPServer bind ALL interfaces
+        (INADDR_ANY) — it must fail closed like any non-loopback bind,
+        not slip through as 'loopback' via the empty-string case
+        (round-5 advisory)."""
+        store = Store()
+        server = APIServer(store, host="", port=0).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            wait_for_server(url)  # healthz stays open for probes
+            remote = RemoteStore(url)
+            with pytest.raises(RuntimeError, match="401"):
+                remote.list(store_mod.TPUJOBS)
+        finally:
+            server.stop()
+            store.stop_watchers()
+
+    def test_loopback_host_classifier(self):
+        """'' and '::' are bind-all conventions, never loopback; only
+        localhost and real loopback addresses stay open."""
+        from tf_operator_tpu.runtime.apiserver import _is_loopback_host
+
+        assert _is_loopback_host("localhost")
+        assert _is_loopback_host("127.0.0.1")
+        assert _is_loopback_host("::1")
+        assert not _is_loopback_host("")
+        assert not _is_loopback_host("::")
+        assert not _is_loopback_host("0.0.0.0")
+        assert not _is_loopback_host("10.0.0.5")
+        assert not _is_loopback_host("example.com")
+
+    def test_token_check_constant_time_comparison(self, authed):
+        """The hmac.compare_digest path must accept exactly the stored
+        tokens — prefixes and case variants 401 (pins the per-token
+        comparison rewrite; a timing test would be flaky, so the
+        behavioral contract is what's pinned)."""
+        _, server = authed
+        for bad in ("admin-secre", "admin-secret2", "ADMIN-SECRET", ""):
+            remote = RemoteStore(server.url, token=bad)
+            with pytest.raises(RuntimeError, match="401"):
+                remote.list(store_mod.TPUJOBS)
+        ok = RemoteStore(server.url, token="admin-secret")
+        assert ok.list(store_mod.TPUJOBS) == []
+
 
 class TestTls:
     @pytest.fixture

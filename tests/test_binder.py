@@ -412,5 +412,107 @@ class TestBestFit:
         assert nodes["j1-worker-0"] == "a0" and nodes["j2-worker-0"] == "a0"
 
 
+def add_maintenance_node(store: Store, name: str, chips: int = 8,
+                         domain: str = "") -> None:
+    """A node that is Ready and schedulable but carries an advance
+    maintenance notice (slice-health cordon may not have landed yet)."""
+    from tf_operator_tpu.controller.health import COND_MAINTENANCE
+
+    labels = {constants.LABEL_ICI_DOMAIN: domain} if domain else {}
+    node = Node(
+        metadata=ObjectMeta(name=name, namespace="", labels=labels),
+        spec=NodeSpec(chips=chips))
+    node.status.conditions = {"Ready": "True", COND_MAINTENANCE: "True"}
+    store.create(store_mod.NODES, node)
+
+
+class TestMaintenancePreference:
+    """HealthPolicy.prefer_spare_capacity: placement steers away from
+    maintenance-pending nodes while they are still schedulable."""
+
+    def test_slice_prefers_clean_domain_over_best_fit(
+            self, store, client, binder):
+        # dom-tight best-fits the slice but is maintenance-pending;
+        # clean dom-big must win despite worse fit.
+        add_node(store, "big0", 8, "dom-big")
+        add_node(store, "big1", 8, "dom-big")
+        add_maintenance_node(store, "tight", 8, "dom-tight")
+        add_group(store, "j1", "v5e-8")
+        add_pod(store, "j1", "worker", 0)
+        assert binder.bind_pass() == 1
+        assert bound_nodes(client)["j1-worker-0"] in ("big0", "big1")
+
+    def test_coordinator_prefers_clean_node(self, store, client, binder):
+        # The pending node has MORE free chips — most-free would pick
+        # it; the clean-first key must override.
+        add_maintenance_node(store, "pending", 8, "dom-a")
+        add_node(store, "clean", 4, "dom-a")
+        add_group(store, "j1", "v5e-8")
+        add_pod(store, "j1", "chief", 0, chips=None)
+        assert binder.bind_pass() == 1
+        assert bound_nodes(client)["j1-chief-0"] == "clean"
+
+    def test_pending_capacity_still_used_when_nothing_else_fits(
+            self, store, client, binder):
+        add_maintenance_node(store, "pending", 8, "dom-a")
+        add_group(store, "j1", "v5e-8")
+        add_pod(store, "j1", "worker", 0)
+        assert binder.bind_pass() == 1
+        assert bound_nodes(client)["j1-worker-0"] == "pending"
+
+    def test_policy_opt_out_restores_best_fit(self, store, client,
+                                              binder):
+        # prefer_spare_capacity=False on the job: pure best-fit again.
+        from tf_operator_tpu.api.types import (
+            HealthPolicy,
+            RunPolicy,
+            TPUJob,
+            TPUJobSpec,
+        )
+
+        add_node(store, "big0", 8, "dom-big")
+        add_node(store, "big1", 8, "dom-big")
+        add_maintenance_node(store, "tight", 8, "dom-tight")
+        job = TPUJob(metadata=ObjectMeta(name="j1", namespace="default"))
+        job.spec = TPUJobSpec(run_policy=RunPolicy(
+            health_policy=HealthPolicy(enabled=True,
+                                       prefer_spare_capacity=False)))
+        store.create(store_mod.TPUJOBS, job)
+        add_group(store, "j1", "v5e-8")
+        add_pod(store, "j1", "worker", 0)
+        assert binder.bind_pass() == 1
+        assert bound_nodes(client)["j1-worker-0"] == "tight"
+
+
+class TestPartialComplementGate:
+    def test_partial_slice_waits_for_full_complement(
+            self, store, client, binder):
+        """A 2-host slice with only one pod visible (gang recreation in
+        flight) must NOT bind — a singleton placed into a domain that
+        cannot hold the rest splits the slice (round-6 drain e2e)."""
+        add_node(store, "a0", 8, "dom-a")          # can hold ONE host
+        add_node(store, "b0", 8, "dom-b")
+        add_node(store, "b1", 8, "dom-b")
+        add_group(store, "j1", "v5e-16")
+        add_pod(store, "j1", "worker", 0)
+        assert binder.bind_pass() == 0             # waits for worker-1
+        add_pod(store, "j1", "worker", 1)
+        assert binder.bind_pass() == 2
+        nodes = bound_nodes(client)
+        assert {nodes["j1-worker-0"], nodes["j1-worker-1"]} == {"b0", "b1"}
+
+    def test_pinned_straggler_still_binds_alone(self, store, client,
+                                                binder):
+        # Restart case: a peer is already bound, so the lone recreated
+        # pod must bind into the pinned domain without waiting.
+        add_node(store, "a0", 8, "dom-a")
+        add_node(store, "a1", 8, "dom-a")
+        add_group(store, "j1", "v5e-16")
+        add_pod(store, "j1", "worker", 0, node="a0")
+        add_pod(store, "j1", "worker", 1)
+        assert binder.bind_pass() == 1
+        assert bound_nodes(client)["j1-worker-1"] == "a1"
+
+
 # CI shard (pyproject [tool.pytest.ini_options] markers)
 pytestmark = pytest.mark.control_plane

@@ -86,6 +86,17 @@ def test_server_constructs_with_all_gang_flags():
         server.shutdown()
 
 
+def test_slice_health_flags_parse_with_defaults():
+    args = build_parser().parse_args(BASE)
+    assert args.slice_health is True
+    assert args.health_drain_grace_seconds == 0.0
+    args = build_parser().parse_args(BASE + [
+        "--no-enable-slice-health",
+        "--health-drain-grace-seconds", "45"])
+    assert args.slice_health is False
+    assert args.health_drain_grace_seconds == 45.0
+
+
 def test_main_rejects_malformed_gang_map(capsys):
     """Malformed map flags must produce an argparse usage error (exit
     code 2 with the offending flag named), never a raw traceback."""

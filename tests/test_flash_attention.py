@@ -207,3 +207,66 @@ def test_best_attention_rejects_indivisible_gqa_heads():
 
 # CI shard (pyproject [tool.pytest.ini_options] markers)
 pytestmark = pytest.mark.compute
+
+
+class TestLseContract:
+    """lse is a non-differentiable auxiliary output (contract at
+    _flash): _flash_bwd discards its cotangent, and anything exposing
+    lse must gate it through _guard_lse_nondiff so a differentiating
+    caller fails loudly instead of training with silent zero grads
+    (round-5 advisory)."""
+
+    def _flash_outputs(self, q, k, v):
+        from tf_operator_tpu.ops.flash_attention import _flash
+
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        return _flash(qt, kt, vt, True, 0, 256, 256, True)
+
+    def test_guard_raises_on_lse_differentiation(self):
+        from tf_operator_tpu.ops.flash_attention import _guard_lse_nondiff
+
+        q, k, v = make_qkv()
+
+        def loss(q):
+            _, lse = self._flash_outputs(q, k, v)
+            return jnp.sum(_guard_lse_nondiff(lse))
+
+        with pytest.raises(NotImplementedError, match="lse"):
+            jax.grad(loss)(q)
+
+    def test_guard_is_identity_forward(self):
+        from tf_operator_tpu.ops.flash_attention import _guard_lse_nondiff
+
+        q, k, v = make_qkv()
+        _, lse = self._flash_outputs(q, k, v)
+        np.testing.assert_array_equal(_guard_lse_nondiff(lse), lse)
+
+    def test_bwd_discards_lse_cotangent(self):
+        """Pins the documented _flash_bwd contract: an UNGATED lse
+        consumer gets exactly-zero grads (why the guard exists). If
+        this ever starts returning nonzero, the lse cotangent was
+        implemented — delete the guard and this pin together."""
+        q, k, v = make_qkv()
+
+        def loss(q):
+            _, lse = self._flash_outputs(q, k, v)
+            return jnp.sum(lse)
+
+        grads = jax.grad(loss)(q)
+        np.testing.assert_array_equal(np.asarray(grads), 0.0)
+
+    def test_out_gradients_unaffected_by_guard_presence(self):
+        q, k, v = make_qkv()
+
+        def loss_flash(q):
+            out, _ = self._flash_outputs(q, k, v)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        def loss_ref(q):
+            out = attention(q, k, v, causal=True)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        g1 = jax.grad(loss_flash)(q)
+        g2 = jax.grad(loss_ref)(q)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=2e-2, atol=2e-2)

@@ -712,6 +712,48 @@ users:
         assert "completionTime" not in (raw.get("status") or {}), \
             "omitted field survived the status patch"
 
+    def test_node_missing_ready_condition_is_not_ready(self):
+        """kube-scheduler convention: a Node whose kubelet never
+        heartbeated (NO Ready condition at all) is NotReady — its chips
+        must not enter the gang-admission budget."""
+        from tf_operator_tpu.controller.binder import node_is_schedulable
+        from tf_operator_tpu.runtime.kube import node_from_k8s
+
+        raw = {"metadata": {"name": "cold"},
+               "spec": {},
+               "status": {"allocatable": {constants.RESOURCE_TPU: "8"}}}
+        node = node_from_k8s(raw)
+        assert node.status.phase == "NotReady"
+        assert not node_is_schedulable(node)
+
+    def test_node_ready_condition_parsed(self):
+        from tf_operator_tpu.runtime.kube import node_from_k8s
+
+        raw = {"metadata": {"name": "warm"}, "spec": {},
+               "status": {"conditions": [
+                   {"type": "Ready", "status": "True"},
+                   {"type": "MaintenancePending", "status": "True"}]}}
+        node = node_from_k8s(raw)
+        assert node.status.phase == "Ready"
+        assert node.status.conditions == {"Ready": "True",
+                                          "MaintenancePending": "True"}
+
+    def test_never_heartbeated_node_excluded_from_capacity(
+            self, client, fake):
+        """End to end through the informer: a conditions-less node
+        contributes nothing to the admission chip budget."""
+        fake.state.add_node("cold", chips=8, ici_domain="d1", ready=None)
+        fake.state.add_node("warm", chips=8, ici_domain="d1")
+        op = KubeOperator(client, post_events=False,
+                          enable_gang_scheduling=True)
+        op.start(threadiness=1, sync_timeout=10)
+        try:
+            wait_for(lambda: len(op.store.list(store_mod.NODES)) == 2,
+                     msg="nodes mirrored")
+            assert op._cluster_chip_capacity() == 8
+        finally:
+            op.stop()
+
 
 class TestGangPdb:
     def test_gang_job_gets_pdb_and_cleanup(self, client, fake):

@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tf_operator_tpu.compat import shard_map
 from tf_operator_tpu.parallel.mesh import MeshConfig, make_mesh
 from tf_operator_tpu.parallel.pipeline import (
     merge_microbatches,
@@ -207,7 +208,7 @@ def test_last_stage_only_output():
     # With gather_output=False ranks disagree (zeros off the last
     # stage), so out_specs=P() replication would be wrong — fetch
     # per-rank outputs via a pp-leading axis instead.
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda p, mbx: inner(p, mbx)[None], mesh=mesh,
         in_specs=(pspec, P()), out_specs=P("pp"), check_vma=False)
     per_rank = fn(stacked, mb)

@@ -294,6 +294,36 @@ class SchedulingPolicy(ApiObject):
 
 
 @dataclasses.dataclass
+class HealthPolicy(ApiObject):
+    """Slice-health / auto-repair knobs (controller/health.py).
+
+    No reference analog: the reference delegated node lifecycle to the
+    cluster (kubelet NotReady taints, external drain tooling). TPU gangs
+    need operator-owned handling — one degraded chip stalls the whole
+    gang, so the unit of repair is the slice, not the pod.
+
+    enabled:               opt this job into gang drain/rebind when a
+                           node hosting it degrades (cordoning of
+                           maintenance-pending nodes is operator-wide
+                           and independent of any job's policy).
+    drain_grace_seconds:   observed-degraded to gang-evict delay (a
+                           checkpoint window); None = the operator's
+                           --health-drain-grace-seconds default.
+    handle_maintenance:    react to advance maintenance notices
+                           (MaintenancePending). Off = drain only on
+                           hard signals (NotReady, TerminationScheduled).
+    prefer_spare_capacity: steer this job's (re)binds away from
+                           maintenance-pending nodes while they are
+                           still schedulable.
+    """
+
+    enabled: bool = False
+    drain_grace_seconds: Optional[float] = None
+    handle_maintenance: bool = True
+    prefer_spare_capacity: bool = True
+
+
+@dataclasses.dataclass
 class RunPolicy(ApiObject):
     """Reference common/v1/types.go:107-148."""
 
@@ -302,6 +332,8 @@ class RunPolicy(ApiObject):
     active_deadline_seconds: Optional[int] = None
     backoff_limit: Optional[int] = None
     scheduling_policy: Optional[SchedulingPolicy] = None
+    # TPU extension: maintenance-aware slice health (controller/health.py).
+    health_policy: Optional[HealthPolicy] = None
 
 
 @dataclasses.dataclass
@@ -411,6 +443,12 @@ class SliceGroupStatus(ApiObject):
     # fresh backfill grace window instead of blocking instantly off its
     # old creationTimestamp.
     pending_since: Optional[_dt.datetime] = None
+    # Why the slice-health controller displaced this group (e.g.
+    # "MaintenancePending on node-3"); non-empty from drain until the
+    # gang is fully back up. The engine rolls it into the job's
+    # Restarting condition so restart-with-identity is visible on the
+    # job; promotion back to Running clears it.
+    displaced_reason: str = ""
 
 
 @dataclasses.dataclass
@@ -446,6 +484,12 @@ class NodeStatus(ApiObject):
     # Base URL of the node agent's log server; the API server proxies
     # pod-log reads here (kubelet log API analog).
     log_url: str = ""
+    # Node conditions by type -> status ("True"/"False"/"Unknown"), the
+    # core/v1 NodeCondition subset the slice-health controller keys on:
+    # Ready plus degradation signals (MaintenancePending,
+    # TerminationScheduled — TPU maintenance events / spot preemption
+    # notices surfaced as conditions, node-problem-detector style).
+    conditions: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclasses.dataclass

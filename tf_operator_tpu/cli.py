@@ -124,7 +124,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "itself — no external Volcano-class scheduler. "
                         "--no-gang-binder reverts to stamping "
                         "schedulerName only (an external gang scheduler "
-                        "must then bind)")
+                        "must then bind). NOTE: node-derived admission "
+                        "capacity assumes a SINGLE-TENANT cluster — "
+                        "chips held by pods outside the operator's "
+                        "bookkeeping (foreign controllers, other "
+                        "namespaces when --namespace is set) are "
+                        "invisible to gang admission (docs/health.md)")
+    p.add_argument("--enable-slice-health", dest="slice_health",
+                   default=True, action=argparse.BooleanOptionalAction,
+                   help="(kube backend, with the gang binder) run the "
+                        "slice-health controller: cordon nodes on "
+                        "maintenance/preemption notices and, for jobs "
+                        "whose runPolicy.healthPolicy opts in, "
+                        "atomically drain affected gangs and rebind "
+                        "them on spare capacity (docs/health.md)")
+    p.add_argument("--health-drain-grace-seconds", type=float,
+                   default=0.0,
+                   help="operator-wide default for the observed-"
+                        "degraded to gang-evict delay (a checkpoint "
+                        "window); a job's healthPolicy."
+                        "drainGraceSeconds overrides it")
     p.add_argument("--monitoring-port", type=int, default=8443,
                    help="port for /metrics, /healthz "
                         "(0 = disabled, -1 = ephemeral)")
@@ -234,6 +253,9 @@ class Server:
                 client,
                 namespace=args.namespace or None,
                 gang_binder=args.gang_binder,
+                slice_health=getattr(args, "slice_health", True),
+                health_drain_grace_seconds=getattr(
+                    args, "health_drain_grace_seconds", 0.0),
                 **gang_kwargs)
             self.store = self.operator.store
             self._lease_store = KubeLeaseStore(client)

@@ -1,0 +1,23 @@
+"""Version-bridging aliases for JAX APIs that moved or were renamed.
+
+The compute plane targets the current JAX surface (``jax.shard_map``
+with ``check_vma``); older releases ship the same machinery as
+``jax.experimental.shard_map.shard_map`` with the flag named
+``check_rep``. Bridging here keeps every kernel/parallelism call site on
+one spelling instead of scattering hasattr probes.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+else:  # pre-rename JAX: experimental module, check_rep flag
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)

@@ -42,6 +42,10 @@ from typing import Dict, List, Optional, Tuple
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import Node, Pod, SliceGroup
 from tf_operator_tpu.bootstrap.topology import parse_accelerator
+from tf_operator_tpu.controller.health import (
+    job_health_policy,
+    node_maintenance_pending,
+)
 from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod
 from tf_operator_tpu.runtime.events import (
@@ -96,12 +100,17 @@ def node_ici_domain(node: Node) -> str:
 
 
 class _NodeState:
-    __slots__ = ("name", "domain", "free")
+    __slots__ = ("name", "domain", "free", "pending")
 
-    def __init__(self, name: str, domain: str, free: int):
+    def __init__(self, name: str, domain: str, free: int,
+                 pending: bool = False):
         self.name = name
         self.domain = domain
         self.free = free
+        # Maintenance-pending: still schedulable (the health controller
+        # may not have cordoned it yet, or cordoning is disabled) but
+        # announced to degrade — placement prefers clean capacity.
+        self.pending = pending
 
 
 class SliceGangBinder:
@@ -194,7 +203,7 @@ class SliceGangBinder:
                 continue
             states[n.metadata.name] = _NodeState(
                 n.metadata.name, domain_of_any[n.metadata.name],
-                n.spec.chips)
+                n.spec.chips, pending=node_maintenance_pending(n))
 
         # Chip accounting is deliberately UNSCOPED: node capacity is
         # cluster-wide, so occupancy must be too. (A namespace-scoped
@@ -266,6 +275,15 @@ class SliceGangBinder:
                                      max(1, sl.num_slices))
             hps = max(1, topo.hosts_per_slice)
 
+        # Spare-capacity preference (HealthPolicy.prefer_spare_capacity,
+        # default on even without a policy): place away from
+        # maintenance-pending nodes while clean capacity fits, so a gang
+        # bound (or REBOUND after a drain) isn't handed straight to the
+        # next node scheduled to degrade.
+        policy = job_health_policy(
+            self.store.try_get(store_mod.TPUJOBS, ns, name))
+        prefer_clean = policy is None or policy.prefer_spare_capacity
+
         by_slice: Dict[int, List[Pod]] = {}
         flexible: List[Pod] = []
         for p in group_pods:
@@ -297,8 +315,24 @@ class SliceGangBinder:
 
         bound = 0
         for slice_id in sorted(by_slice):
+            if (pinned.get(slice_id) is None
+                    and len(by_slice[slice_id]) < hps):
+                # No member bound yet and the slice's full pod
+                # complement isn't visible (the engine recreates a
+                # drained/evicted gang one create at a time, and the
+                # binder races those creates): placing the partial set
+                # would pin the slice to a domain that may not hold the
+                # rest — the round-6 drain e2e caught exactly that
+                # split. Wait; the missing pods' ADDED events re-wake
+                # the pass.
+                log.debug("slice %d of gang %s/%s has %d/%d pods "
+                          "visible; waiting for the full complement",
+                          slice_id, ns, name,
+                          len(by_slice[slice_id]), hps)
+                continue
             plan = self._plan_slice(by_slice[slice_id], states,
-                                    pinned.get(slice_id))
+                                    pinned.get(slice_id),
+                                    prefer_clean=prefer_clean)
             if plan is None:
                 self._warn_unplaceable(ns, name, slice_id,
                                        by_slice[slice_id])
@@ -323,7 +357,8 @@ class SliceGangBinder:
                              f"{slice_id} to ICI domain "
                              f"{committed[0][1].domain}")
         for pod in flexible:
-            st = self._pick_flexible_node(pod, states)
+            st = self._pick_flexible_node(pod, states,
+                                          prefer_clean=prefer_clean)
             if st is None:
                 self._warn_unplaceable(ns, name, -1, [pod])
                 continue
@@ -335,22 +370,30 @@ class SliceGangBinder:
         return bound
 
     def _plan_slice(self, pods: List[Pod], states: Dict[str, _NodeState],
-                    pinned_domain: Optional[str]
+                    pinned_domain: Optional[str],
+                    prefer_clean: bool = True
                     ) -> Optional[List[Tuple[Pod, _NodeState]]]:
         """All-or-nothing placement of one slice's pods into ONE ICI
         domain. Best-fit: try the domain with the least total free that
-        still fits (leaves big domains whole for big slices); within a
-        domain, each pod lands on the fullest node that still fits it.
-        Returns the (pod, node) plan, or None when no domain fits."""
+        still fits (leaves big domains whole for big slices); with
+        ``prefer_clean``, domains containing maintenance-pending nodes
+        sort after fully-clean ones regardless of fit (a slice placed
+        onto announced-to-degrade capacity is a drain waiting to
+        happen). Within a domain, each pod lands on the fullest
+        clean-first node that still fits it. Returns the (pod, node)
+        plan, or None when no domain fits."""
         demands = sorted(pods, key=pod_chip_demand, reverse=True)
         by_domain: Dict[str, List[_NodeState]] = {}
         for st in states.values():
             by_domain.setdefault(st.domain, []).append(st)
+
+        def domain_key(d):
+            tainted = (prefer_clean
+                       and any(s.pending for s in by_domain[d]))
+            return (tainted, sum(s.free for s in by_domain[d]))
+
         candidates = ([pinned_domain] if pinned_domain is not None
-                      else sorted(
-                          by_domain,
-                          key=lambda d: sum(s.free
-                                            for s in by_domain[d])))
+                      else sorted(by_domain, key=domain_key))
         for domain in candidates:
             nodes = by_domain.get(domain)
             if not nodes:
@@ -364,7 +407,9 @@ class SliceGangBinder:
                 if not fitting:
                     ok = False
                     break
-                best = min(fitting, key=lambda st: free[st.name])
+                best = min(fitting,
+                           key=lambda st: (prefer_clean and st.pending,
+                                           free[st.name]))
                 free[best.name] -= need
                 plan.append((pod, best))
             if ok:
@@ -372,15 +417,19 @@ class SliceGangBinder:
         return None
 
     @staticmethod
-    def _pick_flexible_node(pod: Pod, states: Dict[str, _NodeState]
+    def _pick_flexible_node(pod: Pod, states: Dict[str, _NodeState],
+                            prefer_clean: bool = True
                             ) -> Optional[_NodeState]:
         need = pod_chip_demand(pod)
         fitting = [st for st in states.values() if st.free >= need]
         if not fitting:
             return None
-        # Most-free node: keeps coordinator pods off nearly-full TPU
-        # hosts a later slice may need whole.
-        return max(fitting, key=lambda st: st.free)
+        # Most-free node, clean (no maintenance notice) first: keeps
+        # coordinator pods off nearly-full TPU hosts a later slice may
+        # need whole, and off nodes announced to degrade.
+        return max(fitting,
+                   key=lambda st: (not (prefer_clean and st.pending),
+                                   st.free))
 
     def _bind(self, pod: Pod, st: _NodeState) -> str:
         """-> "bound" | "conflict" (another binder won: settled) |

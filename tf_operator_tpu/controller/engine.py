@@ -140,6 +140,13 @@ class GangScheduler(abc.ABC):
     def annotate_pod(self, job: TPUJob, pod: Pod, rtype: str) -> None:
         ...
 
+    def displaced_reason(self, job: TPUJob) -> Optional[str]:
+        """Non-empty while the job's gang is displaced by a slice-health
+        drain (controller/health.py) and not yet fully back up; the
+        engine rolls it into the job's Restarting condition. Schedulers
+        without a health subsystem report None."""
+        return None
+
 
 @dataclass
 class EngineConfig:
@@ -237,6 +244,23 @@ class JobEngine:
         # General path.
         if self.config.enable_gang_scheduling and self.gang:
             self.gang.sync_slice_group(job, replica_specs)
+            # Slice-health drain in progress: surface restart-with-
+            # identity on the job — Restarting until the gang is fully
+            # back up, then the status machine flips it to Running (the
+            # marker is cleared on the group's promotion, gang.py).
+            # Level-triggered and quiet: update_job_conditions no-ops
+            # when already set, and the one-shot SliceDrained event +
+            # slice_drains_total metric fire at the drain edge in
+            # controller/health.py — re-asserting here must not spam.
+            displaced = self.gang.displaced_reason(job)
+            if displaced:
+                cond.update_job_conditions(
+                    job.status, JobConditionType.RESTARTING,
+                    cond.JOB_RESTARTING_REASON,
+                    f"TPUJob {job.metadata.name} is restarting: gang "
+                    f"drained ({displaced}); replicas will rebind on "
+                    "spare capacity and resume from the latest "
+                    "checkpoint")
 
         for rtype, spec in replica_specs.items():
             self.reconcile_pods(job, pods, rtype, spec, replica_specs)

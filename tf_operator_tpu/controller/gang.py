@@ -240,7 +240,22 @@ class SliceGangScheduler(GangScheduler):
         min_member = group.spec.min_member or 0
         if group.status.phase == PHASE_INQUEUE:
             if live > 0 and live >= min_member:
+                if group.status.displaced_reason and not \
+                        self._gang_live_in_store(group, min_member):
+                    # Displaced by a slice-health drain: the job's
+                    # replica tallies are STALE on the first sync after
+                    # the eviction (they still count the deleted pods),
+                    # so promotion must verify against live pod state —
+                    # otherwise the group snaps back to Running and the
+                    # repair arc (Restarting condition, rebind
+                    # stopwatch) is erased before the rebind happened.
+                    return
                 group.status.phase = PHASE_RUNNING
+                # A drain-displaced gang that reached Running again has
+                # completed its repair arc: clear the marker so the
+                # engine flips the job's Restarting condition back to
+                # Running.
+                group.status.displaced_reason = ""
                 self.store.update_status(store_mod.SLICEGROUPS, group)
                 log.info("slice group %s running (%d live pods)",
                          group.metadata.name, live)
@@ -251,6 +266,54 @@ class SliceGangScheduler(GangScheduler):
                 log.info("slice group %s lost pods (%d live < minMember "
                          "%d); demoted to Inqueue", group.metadata.name,
                          live, min_member)
+
+    def _gang_live_in_store(self, group: SliceGroup,
+                            min_member: int) -> bool:
+        """Ground truth for a displaced group's liveness: actually
+        Running/Succeeded pods in the store, not job-status tallies."""
+        live = sum(
+            1 for p in self.store.list(
+                store_mod.PODS, namespace=group.metadata.namespace,
+                selector={constants.LABEL_JOB_NAME: group.metadata.name})
+            if p.status.phase in ("Running", "Succeeded"))
+        return live >= min_member
+
+    def displace(self, namespace: str, name: str, reason: str) -> bool:
+        """Slice-health drain hook (controller/health.py): push an
+        admitted group back through admission after its pods were
+        evicted off a degraded node. Phase -> Pending releases the
+        group's chip booking and its ICI-domain reservation; a fresh
+        pending_since grants a new aging grace window; the kept
+        creationTimestamp means the displaced group re-enters the queue
+        at its original priority AHEAD of equal-priority newcomers
+        (admission orders by creation time — see _admit). The
+        displaced_reason marker surfaces as the job's Restarting
+        condition (engine.py) until the gang runs again."""
+        group = self.store.try_get(store_mod.SLICEGROUPS, namespace, name)
+        if group is None or group.status.phase == PHASE_PENDING:
+            return False
+        group.status.phase = PHASE_PENDING
+        group.status.pending_since = _now()
+        group.status.displaced_reason = reason
+        try:
+            self.store.update_status(store_mod.SLICEGROUPS, group)
+        except (store_mod.ConflictError, store_mod.NotFoundError):
+            return False  # racing sync; the next health pass retries
+        log.info("displaced slice group %s/%s (%s); re-entering "
+                 "admission at original priority", namespace, name,
+                 reason)
+        self._admit()  # freed chips may admit it (or others) right away
+        return True
+
+    def displaced_reason(self, job: TPUJob) -> Optional[str]:
+        """Engine hook: non-empty while the job's gang is displaced by a
+        drain and not yet fully back up."""
+        group = self.store.try_get(store_mod.SLICEGROUPS,
+                                   job.metadata.namespace,
+                                   job.metadata.name)
+        if group is None:
+            return None
+        return group.status.displaced_reason or None
 
     def readmit(self) -> None:
         """Re-run admission off a capacity change (the binder calls this

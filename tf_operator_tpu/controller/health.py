@@ -1,0 +1,397 @@
+"""Slice-health & auto-repair: maintenance-aware node lifecycle with
+gang drain/rebind.
+
+The dominant real-world failure mode on TPU fleets is not the job's own
+code — it is the node under it going away mid-run: scheduled maintenance
+events, device-plugin loss, spot preemption ("Exploring the limits of
+Concurrency in ML Training on Google TPUs", arXiv:2011.03641). At pod
+scale one bad chip stalls the whole gang, so the unit of repair is the
+*slice*, never the pod. The reference operator had no answer here — it
+delegated node lifecycle to the cluster (kubelet taints, external
+``kubectl drain`` tooling) and its gangs simply failed.
+
+This controller closes the loop the way the binder closed placement:
+
+1. **Watch** Node state mirrored by the informer: the Ready condition
+   (a missing one means a never-heartbeated kubelet — NotReady, see
+   ``runtime/kube.py node_from_k8s``) plus TPU degradation signals
+   surfaced as conditions — ``MaintenancePending`` (advance maintenance
+   notice; node still Ready and serving) and ``TerminationScheduled``
+   (spot-preemption / imminent-termination notice).
+2. **Classify** each node Healthy / Degraded / Draining
+   (``classify_node``). Degraded nodes carrying an advance notice are
+   **cordoned** (``spec.unschedulable``) so the binder stops targeting
+   them and their chips leave the admission budget — the shared
+   schedulability predicate (``binder.node_is_schedulable``) makes both
+   happen at once. Transiently-NotReady nodes are *not* cordoned
+   (kubelet restarts must not leave permanent cordons; NotReady already
+   excludes them from capacity and placement).
+3. **Drain** affected SliceGroups atomically, per the job's
+   ``HealthPolicy`` (opt-in, with a drain grace window for a final
+   checkpoint): evict the *whole* gang through pod control, then
+   ``gang.displace()`` the group — back to Pending, fresh aging window,
+   ICI-domain reservation released — so it re-enters gang admission
+   ahead of equal-priority newcomers (admission orders by creation
+   time, which a displaced group keeps).
+4. **Rebind & resume**: the engine recreates the evicted pods with the
+   same identity (restart-with-identity), the recreated pods re-gate on
+   the now-Pending group, admission re-admits onto the remaining spare
+   capacity, and the binder places the slice whole in a healthy ICI
+   domain — preferring non-maintenance-pending nodes
+   (``HealthPolicy.prefer_spare_capacity``). The job resumes from its
+   latest checkpoint; the displaced marker surfaces as a Restarting
+   condition on the job (engine.py) until the gang is fully back up.
+
+Level-triggered and stateless where it matters: every pass re-derives
+degraded nodes and affected gangs from the informer cache, so failed
+cordons/evictions retry, an operator restart mid-drain converges, and a
+healed node (signal cleared before the grace expired) cancels the drain.
+Only the drain-grace anchor and the time-to-rebind stopwatch are
+in-memory — losing them on failover costs one grace window restart and
+one histogram sample, never correctness.
+
+Observability: ``NodeCordoned`` / ``SliceDrainPending`` /
+``SliceDrained`` / ``SliceRebound`` events (runtime/events.py),
+``tpu_operator_slice_drains_total``,
+``tpu_operator_nodes_cordoned_total`` and the
+``tpu_operator_drain_rebind_seconds`` histogram (docs/monitoring.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import HealthPolicy, Node, Pod, TPUJob
+from tf_operator_tpu.runtime import metrics
+from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.events import (
+    EVENT_TYPE_NORMAL,
+    EVENT_TYPE_WARNING,
+    REASON_NODE_CORDONED,
+    REASON_SLICE_DRAIN_PENDING,
+    REASON_SLICE_DRAINED,
+    REASON_SLICE_REBOUND,
+)
+from tf_operator_tpu.runtime.store import Store
+
+log = logging.getLogger("tpu_operator.health")
+
+# Node health states (classify_node).
+NODE_HEALTHY = "Healthy"
+NODE_DEGRADED = "Degraded"      # degradation signal, not yet cordoned
+NODE_DRAINING = "Draining"      # degradation signal + cordoned
+
+# Condition types read off NodeStatus.conditions. MaintenancePending is
+# the *advance* notice (node still Ready; GKE surfaces TPU maintenance
+# events ahead of time); TerminationScheduled is the imminent spot/
+# preemption warning. Both are cordon-worthy: the node is doomed while
+# still looking placeable.
+COND_READY = "Ready"
+COND_MAINTENANCE = "MaintenancePending"
+COND_TERMINATION = "TerminationScheduled"
+
+# Degradation reasons (also the nodes_cordoned metric label values).
+REASON_NOT_READY = "NotReady"
+
+_TERMINAL_POD_PHASES = ("Succeeded", "Failed")
+
+
+def node_maintenance_pending(node: Node) -> bool:
+    """Advance-notice signal only: the node still serves but should not
+    receive new work if clean capacity exists (binder placement
+    preference)."""
+    c = node.status.conditions
+    return (c.get(COND_MAINTENANCE) == "True"
+            or c.get(COND_TERMINATION) == "True")
+
+
+def node_degradation_reason(node: Node) -> str:
+    """The strongest degradation signal on a node, '' when healthy.
+    Ordered hard-to-soft: a NotReady node is already gone; a
+    TerminationScheduled one is about to be; MaintenancePending is an
+    advance notice jobs may opt out of reacting to."""
+    if node.status.phase not in ("", "Ready"):
+        return REASON_NOT_READY
+    if node.status.conditions.get(COND_TERMINATION) == "True":
+        return COND_TERMINATION
+    if node.status.conditions.get(COND_MAINTENANCE) == "True":
+        return COND_MAINTENANCE
+    return ""
+
+
+def classify_node(node: Node) -> Tuple[str, str]:
+    """-> (Healthy|Degraded|Draining, reason). An admin-cordoned node
+    with no degradation signal stays Healthy — cordons the operator did
+    not place are not its business to drain off."""
+    reason = node_degradation_reason(node)
+    if not reason:
+        return NODE_HEALTHY, ""
+    if node.spec.unschedulable:
+        return NODE_DRAINING, reason
+    return NODE_DEGRADED, reason
+
+
+def job_health_policy(job: Optional[TPUJob]) -> Optional[HealthPolicy]:
+    if job is None:
+        return None
+    return job.spec.run_policy.health_policy
+
+
+class SliceHealthController:
+    """Watches node health and auto-repairs gangs (module docstring).
+
+    Seams mirror the binder's for testability: ``client`` supplies the
+    cordon write (None = cordon via the store, the local/served control
+    plane's path), ``pod_control`` the evictions, ``gang`` the
+    displace/readmit hook. One daemon thread; store watch events wake
+    it, a resync tick bounds staleness.
+    """
+
+    def __init__(self, store: Store, client=None, gang=None,
+                 pod_control=None, recorder=None,
+                 namespace: Optional[str] = None,
+                 default_grace_seconds: float = 0.0,
+                 resync_seconds: float = 1.0):
+        self.store = store
+        self.client = client
+        self.gang = gang
+        self.pod_control = pod_control
+        self.recorder = recorder
+        self.namespace = namespace
+        self.default_grace_seconds = default_grace_seconds
+        self.resync_seconds = resync_seconds
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watchers: list = []
+        # (ns, group) -> monotonic time the degradation was first seen
+        # (drain-grace anchor; episode resets when the signal clears).
+        self._drain_first_seen: Dict[Tuple[str, str], float] = {}
+        # (ns, group) -> monotonic drain time, for the time-to-rebind
+        # histogram; cleared once the gang is fully bound again.
+        self._rebind_started: Dict[Tuple[str, str], float] = {}
+        # Groups already warned about a pending (grace-window) drain.
+        self._warned_pending: set = set()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "SliceHealthController":
+        for kind in (store_mod.NODES, store_mod.PODS,
+                     store_mod.SLICEGROUPS):
+            self._watchers.append(
+                self.store.watch(kind, self._on_event, replay=False))
+        self._thread = threading.Thread(target=self._run,
+                                        name="slice-health",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        for w in self._watchers:
+            w.stop()
+        self._watchers = []
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _on_event(self, etype: str, obj) -> None:
+        self._wake.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.resync_seconds)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.health_pass()
+            except Exception:
+                log.exception("health pass failed; retrying next pass")
+
+    # -- one level-triggered pass ---------------------------------------
+
+    def health_pass(self) -> None:
+        """Classify nodes, cordon advance-notice ones, drain affected
+        gangs whose policy opts in, and close out rebind stopwatches."""
+        degraded: Dict[str, str] = {}  # node name -> reason
+        for node in self.store.list(store_mod.NODES):
+            state, reason = classify_node(node)
+            if state == NODE_HEALTHY:
+                continue
+            degraded[node.metadata.name] = reason
+            if state == NODE_DEGRADED and reason != REASON_NOT_READY:
+                # Advance notices leave the node Ready and placeable —
+                # cordon so the binder stops targeting it and its chips
+                # leave the admission budget. NotReady is already
+                # excluded by the shared schedulability predicate, and
+                # cordoning on it would outlive a kubelet blip forever
+                # (nothing uncordons here).
+                self._cordon(node, reason)
+        self._drain_affected_gangs(degraded)
+        self._observe_rebinds(degraded)
+
+    def _cordon(self, node: Node, reason: str) -> None:
+        name = node.metadata.name
+        try:
+            if self.client is not None:
+                self.client.patch(store_mod.NODES, "", name,
+                                  {"spec": {"unschedulable": True}})
+            else:
+                node = node.deepcopy()
+                node.spec.unschedulable = True
+                self.store.update(store_mod.NODES, node)
+        except (store_mod.NotFoundError, store_mod.ConflictError):
+            return  # node changed/vanished underneath; next pass retries
+        except Exception as e:
+            log.warning("cordoning node %s failed (will retry): %s",
+                        name, e)
+            return
+        metrics.nodes_cordoned.inc(reason=reason)
+        log.info("cordoned node %s (%s)", name, reason)
+        if self.recorder is not None:
+            self.recorder.event(node, EVENT_TYPE_WARNING,
+                                REASON_NODE_CORDONED,
+                                f"Node {name} cordoned: {reason}")
+
+    # -- gang drain ------------------------------------------------------
+
+    def _drain_affected_gangs(self, degraded: Dict[str, str]) -> None:
+        affected = self._affected_groups(degraded)
+        # Episodes that healed (signal cleared, or the pods left the
+        # degraded nodes) stop aging toward eviction.
+        for key in list(self._drain_first_seen):
+            if key not in affected:
+                del self._drain_first_seen[key]
+                self._warned_pending.discard(key)
+        if not affected:
+            return
+        now = time.monotonic()
+        for (ns, name), bad_pods in sorted(affected.items()):
+            job = self.store.try_get(store_mod.TPUJOBS, ns, name)
+            policy = job_health_policy(job)
+            if policy is None or not policy.enabled:
+                continue  # not opted in: the gang is left untouched
+            reasons = sorted({degraded[p.spec.node_name]
+                              for p in bad_pods})
+            if (not policy.handle_maintenance
+                    and all(r == COND_MAINTENANCE for r in reasons)):
+                continue  # advance notices explicitly ignored by policy
+            grace = (policy.drain_grace_seconds
+                     if policy.drain_grace_seconds is not None
+                     else self.default_grace_seconds)
+            first = self._drain_first_seen.setdefault((ns, name), now)
+            if now - first < grace:
+                if (ns, name) not in self._warned_pending:
+                    self._warned_pending.add((ns, name))
+                    self._record(job, EVENT_TYPE_WARNING,
+                                 REASON_SLICE_DRAIN_PENDING,
+                                 f"Gang {name} runs on degraded node(s) "
+                                 f"({', '.join(reasons)}); draining in "
+                                 f"{grace:.0f}s unless they recover")
+                continue
+            self._drain(ns, name, job, bad_pods, reasons)
+
+    def _affected_groups(self, degraded: Dict[str, str]
+                         ) -> Dict[Tuple[str, str], List[Pod]]:
+        """(ns, gang group) -> its live pods bound to degraded nodes."""
+        if not degraded:
+            return {}
+        affected: Dict[Tuple[str, str], List[Pod]] = {}
+        for p in self.store.list(store_mod.PODS,
+                                 namespace=self.namespace):
+            if (p.status.phase in _TERMINAL_POD_PHASES
+                    or p.spec.node_name not in degraded):
+                continue
+            group = p.metadata.annotations.get(
+                constants.ANNOTATION_GANG_GROUP, "")
+            if group:
+                affected.setdefault((p.metadata.namespace, group),
+                                    []).append(p)
+        return affected
+
+    def _drain(self, ns: str, name: str, job: TPUJob,
+               bad_pods: List[Pod], reasons: List[str]) -> None:
+        """Atomic gang drain: evict EVERY live pod of the group (a slice
+        is indivisible — keeping the healthy members would pin the slice
+        to the degraded domain and leave the gang below minMember
+        forever), then displace the SliceGroup back through admission.
+        A failed eviction aborts the pass; the next one re-derives and
+        retries with nothing double-counted."""
+        group_pods = [
+            p for p in self.store.list(
+                store_mod.PODS, namespace=ns,
+                selector={constants.LABEL_JOB_NAME: name})
+            if p.status.phase not in _TERMINAL_POD_PHASES]
+        for p in group_pods:
+            try:
+                if self.pod_control is not None:
+                    self.pod_control.delete_pod(ns, p.metadata.name, job)
+                else:
+                    self.store.try_delete(store_mod.PODS, ns,
+                                          p.metadata.name)
+            except Exception as e:
+                log.warning("draining pod %s/%s of gang %s failed "
+                            "(will retry): %s", ns, p.metadata.name,
+                            name, e)
+                return
+        reason_str = ", ".join(reasons)
+        if self.gang is not None:
+            self.gang.displace(ns, name,
+                               f"node degraded ({reason_str})")
+        metrics.slice_drains.inc(job_namespace=ns)
+        self._rebind_started.setdefault((ns, name), time.monotonic())
+        self._drain_first_seen.pop((ns, name), None)
+        self._warned_pending.discard((ns, name))
+        bad_nodes = sorted({p.spec.node_name for p in bad_pods})
+        log.info("drained gang %s/%s off degraded node(s) %s (%s): "
+                 "%d pod(s) evicted; re-entering gang admission",
+                 ns, name, bad_nodes, reason_str, len(group_pods))
+        self._record(job, EVENT_TYPE_WARNING, REASON_SLICE_DRAINED,
+                     f"Gang {name} drained off degraded node(s) "
+                     f"{', '.join(bad_nodes)} ({reason_str}); "
+                     "re-queued for rebind on spare capacity, will "
+                     "resume from the latest checkpoint")
+
+    # -- time-to-rebind --------------------------------------------------
+
+    def _observe_rebinds(self, degraded: Dict[str, str]) -> None:
+        """Close the drain stopwatch once the displaced gang is fully
+        bound again on healthy capacity."""
+        from tf_operator_tpu.controller.gang import PHASE_PENDING
+
+        for (ns, name), t0 in list(self._rebind_started.items()):
+            sg = self.store.try_get(store_mod.SLICEGROUPS, ns, name)
+            if sg is None:
+                del self._rebind_started[(ns, name)]
+                continue  # job gone mid-repair; nothing to observe
+            if sg.status.phase == PHASE_PENDING:
+                continue  # still gated (or the old pods still mirror)
+            pods = [
+                p for p in self.store.list(
+                    store_mod.PODS, namespace=ns,
+                    selector={constants.LABEL_JOB_NAME: name})
+                if p.status.phase not in _TERMINAL_POD_PHASES]
+            want = max(1, sg.spec.min_member)
+            bound = [p for p in pods if p.spec.node_name]
+            if (len(pods) < want or len(bound) != len(pods)
+                    or any(p.spec.node_name in degraded for p in bound)):
+                continue
+            elapsed = time.monotonic() - t0
+            metrics.drain_rebind_seconds.observe(elapsed,
+                                                 job_namespace=ns)
+            del self._rebind_started[(ns, name)]
+            log.info("gang %s/%s fully rebound %.2fs after drain",
+                     ns, name, elapsed)
+            self._record(self.store.try_get(store_mod.TPUJOBS, ns, name),
+                         EVENT_TYPE_NORMAL, REASON_SLICE_REBOUND,
+                         f"Gang {name} rebound on spare capacity "
+                         f"{elapsed:.2f}s after drain")
+
+    def _record(self, job, etype: str, reason: str, msg: str) -> None:
+        if self.recorder is not None and job is not None:
+            self.recorder.event(job, etype, reason, msg)

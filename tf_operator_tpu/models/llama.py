@@ -21,6 +21,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tf_operator_tpu.compat import shard_map
+
 from tf_operator_tpu.ops.layers import (
     apply_rope,
     attention,
@@ -149,7 +151,7 @@ class LlamaAttention(nn.Module):
             inner = (ring_flash_attention
                      if cfg.attention_impl == "ring_flash"
                      else ring_attention)
-            out = jax.shard_map(
+            out = shard_map(
                 functools.partial(inner, axis_name=cfg.sp_axis,
                                   causal=True),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -35,6 +35,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tf_operator_tpu.compat import shard_map
+
+# jax renamed TPUCompilerParams -> CompilerParams; accept both so the
+# kernel runs against either side of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 _LANES = 128
 # Row statistics (lse, delta) are carried as [..., S, _SUBS] instead of
@@ -157,7 +164,7 @@ def _fwd(q, k, v, causal, q_offset, block_q, block_k, interpret
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -255,7 +262,7 @@ def _bwd_impl(q, k, v, out, lse, do, causal, q_offset, block_q, block_k,
         out_specs=[qspec],
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -285,7 +292,7 @@ def _bwd_impl(q, k, v, out, lse, do, causal, q_offset, block_q, block_k,
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -304,6 +311,17 @@ def _flash(q, k, v, causal, q_offset, block_q, block_k, interpret):
     # backward recompute — with out alone, lse (a backward residual)
     # would force a second forward execution under remat (round-5
     # roofline: that re-execution was ~7% of the Llama step).
+    #
+    # CONTRACT: lse is an auxiliary, NON-DIFFERENTIABLE output — it
+    # exists for remat residual reuse, and _flash_bwd DISCARDS its
+    # cotangent, so differentiating through it trains with silent zero
+    # grads. (custom_vjp symbolic_zeros would let _flash_bwd assert the
+    # cotangent is structurally zero, but it is unsupported under
+    # shard_map, which the sharded path requires.) Anything that
+    # surfaces lse beyond this module must route it through
+    # _guard_lse_nondiff so a differentiating caller fails loudly;
+    # tests/test_flash_attention.py pins both the guard and this
+    # discard contract.
     return _fwd(q, k, v, causal, q_offset, block_q, block_k, interpret)
 
 
@@ -324,13 +342,38 @@ def _flash_fwd(q, k, v, causal, q_offset, block_q, block_k, interpret):
 
 def _flash_bwd(causal, q_offset, block_q, block_k, interpret, res, cots):
     q, k, v, out, lse = res
-    do, _dlse = cots  # lse is auxiliary: nothing differentiates it
+    # lse is auxiliary: its cotangent is DISCARDED (contract at _flash).
+    do, _dlse = cots
     dq, dk, dv = _bwd_impl(q, k, v, out, lse, do, causal, q_offset,
                            block_q, block_k, interpret)
     return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@jax.custom_vjp
+def _guard_lse_nondiff(lse):
+    """Identity gate for exposing lse outside this module: reverse-mode
+    differentiating anything built on the gated value raises at trace
+    time instead of silently flowing the zero cotangent _flash_bwd
+    discards."""
+    return lse
+
+
+def _guard_lse_fwd(lse):
+    return lse, None
+
+
+def _guard_lse_bwd(_, g):
+    raise NotImplementedError(
+        "flash lse is a non-differentiable auxiliary output (saved for "
+        "remat residual reuse); _flash_bwd discards its cotangent, so "
+        "gradients through lse would silently be zero. Implement the "
+        "lse cotangent in _bwd_impl before differentiating through it.")
+
+
+_guard_lse_nondiff.defvjp(_guard_lse_fwd, _guard_lse_bwd)
 
 
 def _fit_block(seq: int, want: int) -> int:
@@ -407,7 +450,7 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
 
     spec = P(data_axes(mesh), None,
              head_axis if head_axis in mesh.axis_names else None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(flash_attention, causal=causal,
                           q_offset=q_offset, interpret=interpret),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tf_operator_tpu.compat import shard_map
+
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "sp", causal: bool = True) -> jax.Array:
@@ -126,7 +128,7 @@ def ring_attention_sharded(mesh: Mesh, q: jax.Array, k: jax.Array,
                                causal=causal) if impl == "flash"
              else functools.partial(ring_attention, axis_name=axis_name,
                                     causal=causal))
-    fn = jax.shard_map(
+    fn = shard_map(
         inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

@@ -47,6 +47,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tf_operator_tpu.compat import shard_map
+
 from tf_operator_tpu.parallel.mesh import data_axes
 
 # stage_fn(stage_params, x) -> y, applied by every pp rank to its own
@@ -142,7 +144,7 @@ def pipeline_sharded(stage_fn: StageFn, stacked_params: Any, x: jax.Array,
         local = jax.tree_util.tree_map(lambda p: p[0], params)
         return pipeline_apply(stage_fn, local, mb, axis_name=axis_name)
 
-    fn = jax.shard_map(inner, mesh=mesh, in_specs=(pspec, xspec),
+    fn = shard_map(inner, mesh=mesh, in_specs=(pspec, xspec),
                        out_specs=xspec, check_vma=False)
     return merge_microbatches(fn(stacked_params,
                                  split_microbatches(x, num_microbatches)))
@@ -337,7 +339,7 @@ def pipeline_train_sharded(stage_fn: StageFn, loss_fn: LossFn,
         grads = jax.tree_util.tree_map(lambda g: g[None], grads)
         return loss, grads
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner, mesh=mesh,
         in_specs=(pspec, xspec, xspec),
         out_specs=(P(), pspec),
@@ -465,7 +467,7 @@ def pipeline_lm_train_sharded(stage_fn: StageFn, loss_fn, embed_fn,
 
     espec = jax.tree_util.tree_map(lambda _: P(), embed_params)
     hspec = jax.tree_util.tree_map(lambda _: P(), head_params)
-    fn = jax.shard_map(
+    fn = shard_map(
         inner, mesh=mesh,
         in_specs=(pspec, espec, hspec, xspec, xspec),
         out_specs=(P(), pspec, espec, hspec), check_vma=False)

@@ -47,6 +47,7 @@ equivalents, runtime/tlsutil.py):
 
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 import socket
@@ -151,7 +152,14 @@ class _Handler(BaseHTTPRequestHandler):
                 "(--api-insecure)")
         auth = self.headers.get("Authorization", "")
         token = auth[7:] if auth.startswith("Bearer ") else ""
-        role = self.tokens.get(token)
+        # Constant-time comparison against EVERY stored token (the
+        # hmac.compare_digest discipline ps.py/agent.py already follow):
+        # a plain dict lookup leaks token-prefix timing, and an early
+        # break would leak which token matched.
+        role = None
+        for stored, stored_role in self.tokens.items():
+            if hmac.compare_digest(stored.encode(), token.encode()):
+                role = stored_role
         if role is None:
             raise _ApiError(401, "Unauthorized",
                             "missing or invalid bearer token")
@@ -471,8 +479,15 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def _is_loopback_host(host: str) -> bool:
-    if host in ("localhost", ""):
+    """Only a host that can ONLY be reached from this machine counts.
+    '' and '::' are bind-ALL-interfaces conventions (ThreadingHTTPServer
+    binds INADDR_ANY for ''; ps.py uses '' the same way), so they must
+    fail closed — treating them as loopback would serve an
+    unauthenticated API on every interface."""
+    if host == "localhost":
         return True
+    if host in ("", "::"):
+        return False
     try:
         import ipaddress
 

@@ -14,6 +14,14 @@ log = logging.getLogger("tpu_operator.events")
 EVENT_TYPE_NORMAL = "Normal"
 EVENT_TYPE_WARNING = "Warning"
 
+# Slice-health / auto-repair event reasons (controller/health.py) — the
+# drain/rebind lifecycle's observable edges, named here so emitters and
+# test/SDK consumers share one vocabulary.
+REASON_NODE_CORDONED = "NodeCordoned"
+REASON_SLICE_DRAIN_PENDING = "SliceDrainPending"
+REASON_SLICE_DRAINED = "SliceDrained"
+REASON_SLICE_REBOUND = "SliceRebound"
+
 
 @dataclass
 class Event:

@@ -655,16 +655,22 @@ def node_from_k8s(d: dict) -> Node:
                           .get(constants.RESOURCE_TPU, 0) or 0))
     except ValueError:
         chips = 0
-    ready = "Ready"
+    conditions: Dict[str, str] = {}
     for cond in status_d.get("conditions") or []:
-        if cond.get("type") == "Ready" and cond.get("status") != "True":
-            ready = "NotReady"
+        ctype = cond.get("type", "")
+        if ctype:
+            conditions[ctype] = cond.get("status", "")
+    # A node with NO Ready condition at all (kubelet never heartbeated)
+    # is NotReady — kube-scheduler's conservative convention. Defaulting
+    # to Ready would put its chips into the gang admission budget and
+    # let the binder target a node nothing is serving on.
+    ready = "Ready" if conditions.get("Ready") == "True" else "NotReady"
     return Node(metadata=meta,
                 spec=NodeSpec(address=address, chips=chips,
                               labels=dict(meta.labels),
                               unschedulable=bool(
                                   spec_d.get("unschedulable"))),
-                status=NodeStatus(phase=ready))
+                status=NodeStatus(phase=ready, conditions=conditions))
 
 
 FROM_K8S: Dict[str, Callable[[dict], object]] = {
@@ -1151,6 +1157,8 @@ class KubeOperator:
                  gang_queue_quotas: Optional[dict] = None,
                  gang_preemption: bool = False,
                  gang_binder: bool = True,
+                 slice_health: bool = True,
+                 health_drain_grace_seconds: float = 0.0,
                  config: Optional[EngineConfig] = None,
                  post_events: bool = True):
         self.client = client
@@ -1208,6 +1216,7 @@ class KubeOperator:
             KubeInformer(client, self.store, store_mod.ENDPOINTS, namespace),
         ]
         self.binder = None
+        self.health = None
         if enable_gang_scheduling and gang_binder:
             from tf_operator_tpu.controller.binder import SliceGangBinder
 
@@ -1217,12 +1226,32 @@ class KubeOperator:
             self.binder = SliceGangBinder(self.store, client, gang,
                                           namespace=namespace,
                                           recorder=recorder)
+            if slice_health:
+                # Slice-health & auto-repair rides the same node
+                # inventory the binder placed from: maintenance-aware
+                # cordon + gang drain/rebind (controller/health.py).
+                from tf_operator_tpu.controller.health import (
+                    SliceHealthController,
+                )
+
+                self.health = SliceHealthController(
+                    self.store, client=client, gang=gang,
+                    pod_control=self.controller.engine.pod_control,
+                    recorder=recorder, namespace=namespace,
+                    default_grace_seconds=health_drain_grace_seconds)
 
     def _cluster_chip_capacity(self) -> int:
         """Gang admission budget from live node inventory: allocatable
         TPU chips across schedulable, Ready nodes (Volcano allocator
         analog — a cordoned or dead-kubelet node's chips must not admit
-        a gang the binder then cannot place)."""
+        a gang the binder then cannot place).
+
+        Single-tenant assumption (documented at the --gang-binder flag
+        and docs/health.md): chips held by pods outside the operator's
+        bookkeeping — foreign controllers, or other namespaces when the
+        operator is namespaced — are invisible to admission occupancy,
+        so on a shared cluster a group can be admitted yet sit
+        unplaceable at the binder until the foreign pods leave."""
         from tf_operator_tpu.controller.binder import node_is_schedulable
 
         total = 0
@@ -1262,9 +1291,13 @@ class KubeOperator:
         self.controller.run(threadiness=threadiness)
         if self.binder is not None:
             self.binder.start()
+        if self.health is not None:
+            self.health.start()
         log.info("kube operator started (threadiness=%d)", threadiness)
 
     def stop(self) -> None:
+        if self.health is not None:
+            self.health.stop()
         if self.binder is not None:
             self.binder.stop()
         self.controller.stop()

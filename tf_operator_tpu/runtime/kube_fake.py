@@ -329,25 +329,69 @@ class FakeKubeState:
 
     def add_node(self, name: str, chips: int = 8, ici_domain: str = "",
                  labels: Optional[Dict[str, str]] = None,
-                 unschedulable: bool = False) -> dict:
+                 unschedulable: bool = False, ready: bool = True) -> dict:
         """Register a core/v1 Node the way a kubelet + TPU device plugin
         would: allocatable google.com/tpu chips plus the ICI-domain
-        label the gang binder keys slice affinity on."""
+        label the gang binder keys slice affinity on. A heartbeating
+        kubelet reports a Ready condition (``ready=False`` models a dead
+        kubelet; a node with NO Ready condition at all — kubelet never
+        heartbeated — is built by passing ``ready=None``)."""
         node_labels = dict(labels or {})
         if ici_domain:
             node_labels[constants.LABEL_ICI_DOMAIN] = ici_domain
+        status: dict = {"allocatable": {
+            constants.RESOURCE_TPU: str(chips)},
+            "addresses": [{"type": "InternalIP",
+                           "address": "10.0.0.1"}]}
+        if ready is not None:
+            status["conditions"] = [{"type": "Ready",
+                                     "status": "True" if ready else "False"}]
         obj = {"apiVersion": "v1", "kind": "Node",
                "metadata": {"name": name, "labels": node_labels},
                "spec": {"unschedulable": unschedulable},
-               "status": {"allocatable": {
-                   constants.RESOURCE_TPU: str(chips)},
-                   "addresses": [{"type": "InternalIP",
-                                  "address": "10.0.0.1"}]}}
+               "status": status}
         return self.create("nodes", "", obj)
 
     def cordon_node(self, name: str, unschedulable: bool = True) -> dict:
         return self.patch("nodes", "", name,
                           {"spec": {"unschedulable": unschedulable}})
+
+    def set_node_condition(self, name: str, ctype: str,
+                           status: str = "True",
+                           reason: str = "") -> dict:
+        """Upsert one node condition the way a kubelet / node-problem-
+        detector status write would (merge patch replaces the whole
+        conditions list, so read-modify-write under the lock)."""
+        with self.lock:
+            node = self.objects["nodes"].get(("", name))
+            if node is None:
+                raise _HttpError(404, "NotFound", f"node {name} not found")
+            conditions = list((node.get("status") or {})
+                              .get("conditions") or [])
+            conditions = [c for c in conditions if c.get("type") != ctype]
+            cond = {"type": ctype, "status": status}
+            if reason:
+                cond["reason"] = reason
+            conditions.append(cond)
+            return self.patch("nodes", "", name,
+                              {"status": {"conditions": conditions}},
+                              subresource="status")
+
+    def inject_maintenance(self, name: str,
+                           reason: str = "ScheduledMaintenance") -> dict:
+        """TPU maintenance notice: the node is still Ready and serving,
+        but the platform has announced an upcoming disruption (GKE
+        surfaces these ahead of TPU maintenance events). The slice-health
+        controller cordons and drains off it."""
+        return self.set_node_condition(name, "MaintenancePending",
+                                       "True", reason=reason)
+
+    def inject_preemption(self, name: str,
+                          reason: str = "SpotPreemption") -> dict:
+        """Spot/preemptible termination notice (the ~30s ACPI warning
+        surfaced as a condition): the node is about to vanish."""
+        return self.set_node_condition(name, "TerminationScheduled",
+                                       "True", reason=reason)
 
     def bind_pod(self, ns: str, name: str, node: str) -> dict:
         """Bindings-API core: assign the pod to a node exactly once (a

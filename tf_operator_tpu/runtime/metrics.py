@@ -268,6 +268,20 @@ gang_pods_bound = REGISTRY.counter(
     "tpu_operator_gang_pods_bound_total",
     "Counts pods the in-operator slice-gang binder bound to nodes",
     ["job_namespace"])
+slice_drains = REGISTRY.counter(
+    "tpu_operator_slice_drains_total",
+    "Counts gang SliceGroups atomically drained off degraded nodes by "
+    "the slice-health controller", ["job_namespace"])
+nodes_cordoned = REGISTRY.counter(
+    "tpu_operator_nodes_cordoned_total",
+    "Counts nodes the slice-health controller cordoned on degradation "
+    "signals", ["reason"])
+drain_rebind_seconds = REGISTRY.histogram(
+    "tpu_operator_drain_rebind_seconds",
+    "Gang drain to fully-rebound-on-spare-capacity latency (slice-health "
+    "auto-repair)", ["job_namespace"],
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+             300.0))
 kube_client_throttled = REGISTRY.counter(
     "tpu_operator_kube_client_throttled_total",
     "Counts 429 responses the kube client honored (slept Retry-After "
