@@ -193,6 +193,34 @@ def bench_resnet50(batch_size: int, image_size: int, steps: int,
                                        if corrected else None)}
 
 
+def bench_environment(chip: str) -> dict:
+    """Environment fingerprint for the artifact: jax version + platform
+    facts, so round-over-round medians are auditable against
+    environment drift (a jax upgrade or a different chip kind behind
+    the tunnel must be visible in the JSON line, not archaeology)."""
+    import platform as _plat
+
+    import jax
+
+    d = jax.devices()[0]
+    return {
+        "jax_version": jax.__version__,
+        "platform": d.platform,
+        "chip_kind": getattr(d, "device_kind", "") or chip,
+        "python": _plat.python_version(),
+    }
+
+
+def bench_config_fingerprint(config: dict) -> str:
+    """Stable digest of the measured configuration — two artifacts with
+    the same fingerprint are comparable; a config drift (batch, stem,
+    dispatch fusion, rep policy) changes it."""
+    import hashlib
+
+    return hashlib.sha1(
+        json.dumps(config, sort_keys=True).encode()).hexdigest()[:12]
+
+
 def main() -> int:
     try:
         import jax
@@ -201,6 +229,10 @@ def main() -> int:
         if chip == "cpu":
             # CPU smoke run is not the benchmark config: report the
             # throughput but claim zero baseline credit.
+            config = {"batch_size": 8, "image_size": 64, "steps": 3,
+                      "warmup": 1, "stem": "conv7", "steps_per_call": 1,
+                      "spread_threshold": SPREAD_THRESHOLD,
+                      "max_extra_reps": MAX_EXTRA_REPS}
             imgs_per_sec, stats = bench_resnet50(batch_size=8, image_size=64,
                                                  steps=3, warmup=1)
             mfu = 0.0
@@ -215,6 +247,10 @@ def main() -> int:
             # fixed per-block sync cost — a measurement artifact the
             # sync_corrected stat already isolates — and would break
             # the round-over-round comparability of the median.
+            config = {"batch_size": 256, "image_size": 224, "steps": 96,
+                      "warmup": 32, "stem": "s2d", "steps_per_call": 32,
+                      "spread_threshold": SPREAD_THRESHOLD,
+                      "max_extra_reps": MAX_EXTRA_REPS}
             imgs_per_sec, stats = bench_resnet50(batch_size=256,
                                                  image_size=224,
                                                  steps=96, warmup=32,
@@ -250,16 +286,23 @@ def main() -> int:
             "vs_baseline": round(mfu / 0.55, 4),
             "stat": "median_of_3",
             "spread": stats,
+            "env": bench_environment(chip),
+            "config_fingerprint": bench_config_fingerprint(config),
         }))
         return 0
     except Exception as e:  # one JSON line, even on failure
-        print(json.dumps({
+        out = {
             "metric": "resnet50_images_per_sec_per_chip",
             "value": 0.0,
             "unit": "images/sec/chip",
             "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}",
-        }))
+        }
+        try:
+            out["env"] = bench_environment("cpu")
+        except Exception:
+            pass  # jax itself broken; the error field carries the story
+        print(json.dumps(out))
         return 1
 
 

@@ -1,0 +1,283 @@
+"""Control-plane churn benchmark: reconcile-path throughput at scale.
+
+The training-path headline (bench.py) is HBM-bound and exhausted; this
+harness watches the OTHER hot path — the reconcile loop — at the fleet
+shapes the pod-scale papers describe (one controller owning thousands
+of pods across hundreds of gangs).
+
+Shape: create N TPUJobs x M worker pods against the in-process Store
+(API-server analog, no data plane), with a fake kubelet driving every
+pod Pending -> Running -> Succeeded. The controller must observe the
+churn, create pods/endpoints, roll up statuses, and converge every job
+to Succeeded. Reported:
+
+- convergence_seconds: first job create -> last job Succeeded
+- jobs_per_sec: N / convergence_seconds (the headline; the acceptance
+  target is >=5x over the pre-PR controller at 200 jobs x 16 pods)
+- syncs + syncs_per_sec and exact p50/p99 reconcile latency (measured
+  around sync_tpujob, not bucketized)
+- deepcopies_per_sync: ApiObject.deepcopy calls / syncs — the
+  per-sync allocation proxy (store snapshots + single-list syncs are
+  exactly the levers that move it)
+
+Prints exactly ONE JSON line (bench.py artifact discipline), with the
+environment fingerprint satellite fields (jax version, platform,
+config fingerprint) so round-over-round medians are auditable.
+
+Usage:
+    python benchmarks/bench_controlplane.py                  # 200x16
+    python benchmarks/bench_controlplane.py --jobs 5 --workers 2  # smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import platform as _platform
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tf_operator_tpu import testutil  # noqa: E402
+from tf_operator_tpu.api.types import (  # noqa: E402
+    ContainerStatus,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodStatus,
+)
+from tf_operator_tpu.api import constants  # noqa: E402
+from tf_operator_tpu.api.serde import ApiObject  # noqa: E402
+from tf_operator_tpu.controller import conditions as cond  # noqa: E402
+from tf_operator_tpu.controller.tpu_controller import (  # noqa: E402
+    TPUJobController,
+)
+from tf_operator_tpu.runtime import store as store_mod  # noqa: E402
+from tf_operator_tpu.runtime.store import Store  # noqa: E402
+
+NAMESPACE = "bench"
+
+
+class FakeKubelet(threading.Thread):
+    """Drives pod phases like a node agent: every tick, Pending pods
+    start Running and Running pods complete with exit 0. One phase per
+    tick so the controller observes the full lifecycle churn."""
+
+    def __init__(self, store: Store, tick: float = 0.01):
+        super().__init__(name="fake-kubelet", daemon=True)
+        self.store = store
+        self.tick = tick
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            transitions = self.store.project(
+                store_mod.PODS,
+                lambda p: ((p.metadata.namespace, p.metadata.name,
+                            p.status.phase)
+                           if p.status.phase in (PodPhase.PENDING,
+                                                 PodPhase.RUNNING)
+                           else None),
+                namespace=NAMESPACE)
+            for ns, name, phase in transitions:
+                patch = Pod(metadata=ObjectMeta(name=name, namespace=ns))
+                if phase == PodPhase.PENDING:
+                    patch.status = PodStatus(phase=PodPhase.RUNNING,
+                                             start_time=testutil.now())
+                else:
+                    patch.status = PodStatus(
+                        phase=PodPhase.SUCCEEDED,
+                        start_time=testutil.now(),
+                        container_statuses=[ContainerStatus(
+                            name=constants.DEFAULT_CONTAINER_NAME,
+                            state="Terminated", exit_code=0)])
+                try:
+                    self.store.update_status(store_mod.PODS, patch)
+                except (store_mod.NotFoundError, store_mod.ConflictError):
+                    pass  # reaped or raced by the controller; benign
+            self._stop.wait(self.tick)
+
+
+class _SyncTimer:
+    """Wraps sync_tpujob to count syncs and record exact durations
+    (the metrics histogram is bucketized; p99 wants raw samples)."""
+
+    def __init__(self, controller: TPUJobController):
+        self._inner = controller.sync_tpujob
+        self.durations: List[float] = []
+        self._lock = threading.Lock()
+        controller.sync_tpujob = self  # type: ignore[assignment]
+
+    def __call__(self, key: str) -> None:
+        t0 = time.perf_counter()
+        try:
+            self._inner(key)
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.durations.append(dt)
+
+    def snapshot(self) -> List[float]:
+        with self._lock:
+            return list(self.durations)
+
+
+class _DeepcopyCounter:
+    """Counts ApiObject.deepcopy calls — the dominant per-sync
+    allocation source in the reconcile path."""
+
+    def __init__(self):
+        self.count = 0
+        self._orig = ApiObject.deepcopy
+        counter = self
+
+        def counted(obj):
+            counter.count += 1
+            return counter._orig(obj)
+
+        ApiObject.deepcopy = counted
+
+    def stop(self) -> int:
+        ApiObject.deepcopy = self._orig
+        return self.count
+
+
+def _percentile(samples: List[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    idx = min(len(s) - 1, int(round(q * (len(s) - 1))))
+    return s[idx]
+
+
+def run_bench(jobs: int, workers: int, threadiness: int,
+              timeout: float, kubelet_tick: float = 0.01) -> Dict:
+    """Returns the artifact dict (not yet JSON-encoded). Raises
+    TimeoutError if the fleet does not converge within ``timeout``."""
+    store = Store()
+    controller = TPUJobController(store, namespace=NAMESPACE)
+    timer = _SyncTimer(controller)
+    copies = _DeepcopyCounter()
+    kubelet = FakeKubelet(store, tick=kubelet_tick)
+
+    controller.run(threadiness=threadiness)
+    kubelet.start()
+    t0 = time.perf_counter()
+    try:
+        for i in range(jobs):
+            job = testutil.new_tpujob(worker=workers, name=f"bench-{i:04d}",
+                                      namespace=NAMESPACE)
+            store.create(store_mod.TPUJOBS, job)
+
+        deadline = t0 + timeout
+        while True:
+            succeeded = sum(store.project(
+                store_mod.TPUJOBS,
+                lambda j: 1 if cond.is_succeeded(j.status) else None,
+                namespace=NAMESPACE))
+            if succeeded >= jobs:
+                break
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"{succeeded}/{jobs} jobs Succeeded after {timeout}s")
+            time.sleep(0.02)
+        convergence = time.perf_counter() - t0
+    finally:
+        kubelet.stop()
+        controller.stop()
+        store.stop_watchers()
+        n_copies = copies.stop()
+
+    durations = timer.snapshot()
+    syncs = len(durations)
+    return {
+        "convergence_seconds": round(convergence, 3),
+        "jobs_per_sec": round(jobs / convergence, 2),
+        "syncs": syncs,
+        "syncs_per_sec": round(syncs / convergence, 1),
+        "reconcile_p50_ms": round(_percentile(durations, 0.50) * 1e3, 3),
+        "reconcile_p99_ms": round(_percentile(durations, 0.99) * 1e3, 3),
+        "deepcopies_per_sync": round(n_copies / max(1, syncs), 1),
+        "jobs": jobs,
+        "workers_per_job": workers,
+        "pods": jobs * workers,
+        "threadiness": threadiness,
+    }
+
+
+def _environment() -> Dict:
+    """Environment fingerprint fields (auditable round-over-round):
+    jax version + platform/chip kind when jax is importable, host facts
+    always. Importing jax is optional — the control plane needs none of
+    it and smoke environments may not have it."""
+    env = {
+        "python": _platform.python_version(),
+        "machine": _platform.machine(),
+        "system": _platform.system(),
+    }
+    try:
+        import jax
+
+        env["jax_version"] = jax.__version__
+        d = jax.devices()[0]
+        env["platform"] = d.platform
+        env["chip_kind"] = getattr(d, "device_kind", "") or d.platform
+    except Exception:
+        env["jax_version"] = None
+        env["platform"] = "none"
+        env["chip_kind"] = "none"
+    return env
+
+
+def config_fingerprint(config: Dict) -> str:
+    return hashlib.sha1(
+        json.dumps(config, sort_keys=True).encode()).hexdigest()[:12]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--jobs", type=int, default=200)
+    p.add_argument("--workers", type=int, default=16)
+    p.add_argument("--threadiness", type=int, default=4)
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--kubelet-tick", type=float, default=0.01)
+    args = p.parse_args(argv)
+
+    config = {"jobs": args.jobs, "workers": args.workers,
+              "threadiness": args.threadiness,
+              "kubelet_tick": args.kubelet_tick}
+    try:
+        result = run_bench(args.jobs, args.workers, args.threadiness,
+                           args.timeout, kubelet_tick=args.kubelet_tick)
+        print(json.dumps({
+            "metric": (f"controlplane_convergence_jobs_per_sec"
+                       f"[{args.jobs}x{args.workers}]"),
+            "value": result["jobs_per_sec"],
+            "unit": "jobs/sec",
+            **result,
+            "env": _environment(),
+            "config_fingerprint": config_fingerprint(config),
+        }))
+        return 0
+    except Exception as e:  # one JSON line, even on failure
+        print(json.dumps({
+            "metric": "controlplane_convergence_jobs_per_sec",
+            "value": 0.0,
+            "unit": "jobs/sec",
+            "error": f"{type(e).__name__}: {e}",
+            "env": _environment(),
+            "config_fingerprint": config_fingerprint(config),
+        }))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,3 +85,28 @@ def test_under_threshold_no_rerun():
     times, discarded = collect_reps(block)
     assert block.calls == 3
     assert discarded == []
+
+
+def test_environment_fingerprint_fields():
+    """The artifact's audit fields (ISSUE 2 satellite): jax version,
+    platform/chip kind, python — so round-over-round medians can be
+    checked against environment drift."""
+    from bench import bench_environment
+
+    env = bench_environment("cpu")
+    assert set(env) == {"jax_version", "platform", "chip_kind", "python"}
+    import jax
+
+    assert env["jax_version"] == jax.__version__
+    assert env["platform"]  # non-empty
+
+
+def test_config_fingerprint_is_stable_and_config_sensitive():
+    from bench import bench_config_fingerprint
+
+    a = bench_config_fingerprint({"batch_size": 256, "stem": "s2d"})
+    b = bench_config_fingerprint({"stem": "s2d", "batch_size": 256})
+    c = bench_config_fingerprint({"batch_size": 512, "stem": "s2d"})
+    assert a == b  # key order irrelevant
+    assert a != c  # config drift changes the fingerprint
+    assert len(a) == 12

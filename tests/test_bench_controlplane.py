@@ -1,0 +1,79 @@
+"""Control-plane benchmark harness smoke + artifact-schema pin.
+
+Mirrors tests/test_bench.py's role for bench.py: the harness itself is
+tier-1-tested in a seconds-scale smoke configuration (5 jobs x 2 pods)
+so a refactor that breaks the churn loop or silently changes the
+artifact schema fails CI, not the next benchmarking round.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+import bench_controlplane  # noqa: E402
+
+# Every key a round-over-round consumer may read. Additions are fine;
+# removals/renames break the audit trail and must show up here.
+ARTIFACT_KEYS = {
+    "metric", "value", "unit",
+    "convergence_seconds", "jobs_per_sec", "syncs", "syncs_per_sec",
+    "reconcile_p50_ms", "reconcile_p99_ms", "deepcopies_per_sync",
+    "jobs", "workers_per_job", "pods", "threadiness",
+    "env", "config_fingerprint",
+}
+
+ENV_KEYS = {"python", "machine", "system", "jax_version", "platform",
+            "chip_kind"}
+
+
+def test_smoke_run_converges_and_reports():
+    result = bench_controlplane.run_bench(jobs=5, workers=2,
+                                          threadiness=4, timeout=30.0)
+    assert result["jobs"] == 5
+    assert result["pods"] == 10
+    assert result["convergence_seconds"] > 0
+    assert result["jobs_per_sec"] > 0
+    assert result["syncs"] >= 5  # at least one sync per job
+    assert result["reconcile_p99_ms"] >= result["reconcile_p50_ms"]
+
+
+def test_artifact_is_one_json_line_with_pinned_schema(capsys):
+    rc = bench_controlplane.main(["--jobs", "5", "--workers", "2",
+                                  "--timeout", "30"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "artifact must be exactly one line"
+    artifact = json.loads(out[0])
+    assert ARTIFACT_KEYS <= set(artifact), (
+        f"missing keys: {ARTIFACT_KEYS - set(artifact)}")
+    assert artifact["metric"].startswith(
+        "controlplane_convergence_jobs_per_sec")
+    assert artifact["unit"] == "jobs/sec"
+    assert artifact["value"] == artifact["jobs_per_sec"]
+    assert ENV_KEYS <= set(artifact["env"])
+    # Fingerprint is config-derived: same config, same fingerprint.
+    assert artifact["config_fingerprint"] == (
+        bench_controlplane.config_fingerprint(
+            {"jobs": 5, "workers": 2, "threadiness": 4,
+             "kubelet_tick": 0.01}))
+
+
+def test_failure_still_emits_one_json_line(capsys):
+    # Impossible timeout: the artifact contract holds on failure too.
+    rc = bench_controlplane.main(["--jobs", "2", "--workers", "1",
+                                  "--timeout", "0.000001"])
+    assert rc == 1
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    artifact = json.loads(out[0])
+    assert artifact["value"] == 0.0
+    assert "error" in artifact
+
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

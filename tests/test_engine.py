@@ -361,6 +361,18 @@ def test_ttl_future_requeues_instead_of_deleting():
     job.spec.run_policy.ttl_seconds_after_finished = 3600
     engine, plugin = run_sync(job, pods=[])
     assert plugin.deleted_jobs == []
+    # Requeued via add_after with the exact remaining TTL (reference
+    # job.go:345-357) — NOT add_rate_limited, whose exponential backoff
+    # fires early-and-often and pollutes the BackoffLimit counter.
+    assert engine.workqueue.num_requeues(job.key()) == 0
+    delayed = [(when, item) for when, _, item
+               in engine.workqueue._delayed if item == job.key()]
+    assert len(delayed) == 1
+    import time as _time
+
+    remaining = delayed[0][0] - _time.monotonic()
+    # completion_time is ~now, so the delay is ~the full TTL.
+    assert 3500 < remaining <= 3600
 
 
 def test_active_deadline_exceeded_fails_job():

@@ -310,5 +310,106 @@ def test_event_store_mirror_capped():
     ev = op.store.list(store_mod.EVENTS)[0]
     assert ev.metadata.labels[constants.LABEL_JOB_NAME] == "capjob"
 
+# --- event aggregation (EventCorrelator analog, ISSUE 2) -----------------
+
+def _named(name="storm-job", ns="default"):
+    from tf_operator_tpu.api.types import ObjectMeta, TPUJob
+
+    return TPUJob(metadata=ObjectMeta(name=name, namespace=ns))
+
+
+def test_exact_duplicate_events_fold_into_count():
+    from tf_operator_tpu.runtime.events import Recorder
+
+    sunk = []
+    r = Recorder(sink=sunk.append)
+    for _ in range(5):
+        r.event(_named(), "Warning", "AbnormalPod", "same message")
+    evs = r.events_for(reason="AbnormalPod")
+    assert len(evs) == 1
+    assert evs[0].count == 5
+    assert len(sunk) == 1, "duplicates must not re-fan-out to the sink"
+
+
+def test_similar_event_storm_collapses_past_threshold():
+    """>threshold distinct-message events with the same (object, type,
+    reason) collapse into one combined record — a 256-pod gang start is
+    ~11 sink calls, not 256 API writes."""
+    from tf_operator_tpu.runtime.events import (
+        SIMILAR_EVENTS_THRESHOLD,
+        Recorder,
+    )
+
+    sunk = []
+    r = Recorder(sink=sunk.append)
+    for i in range(256):
+        r.event(_named(), "Normal", "SuccessfulCreatePod",
+                f"Created pod: w-{i}")
+    evs = r.events_for(reason="SuccessfulCreatePod")
+    assert len(evs) == SIMILAR_EVENTS_THRESHOLD + 1
+    assert len(sunk) == SIMILAR_EVENTS_THRESHOLD
+    combined = [e for e in evs
+                if e.message.startswith("(combined from similar events)")]
+    assert len(combined) == 1
+    assert combined[0].count == 256
+
+
+def test_distinct_reasons_do_not_aggregate():
+    from tf_operator_tpu.runtime.events import Recorder
+
+    r = Recorder()
+    r.event(_named(), "Normal", "ReasonA", "m")
+    r.event(_named(), "Normal", "ReasonB", "m")
+    assert len(r.events) == 2
+
+
+def test_aggregated_events_counted_in_metric():
+    from tf_operator_tpu.runtime import metrics as mx
+    from tf_operator_tpu.runtime.events import Recorder
+
+    before = mx.events_aggregated.value()
+    r = Recorder()
+    for _ in range(4):
+        r.event(_named("metric-job"), "Normal", "Dup", "m")
+    assert mx.events_aggregated.value() == before + 3
+
+
+# --- workqueue instrumentation (gauge owned by the queue, ISSUE 2) --------
+
+def test_workqueue_owns_depth_gauge_and_counts_coalesced():
+    from tf_operator_tpu.runtime import metrics as mx
+    from tf_operator_tpu.runtime.workqueue import RateLimitingQueue
+
+    q = RateLimitingQueue()
+    coalesced_before = mx.workqueue_coalesced.value()
+    q.add("k1")
+    q.add("k2")
+    assert mx.workqueue_depth.value() == 2
+    q.add("k1")  # already pending: coalesced, depth unchanged
+    assert mx.workqueue_depth.value() == 2
+    assert mx.workqueue_coalesced.value() == coalesced_before + 1
+    assert q.get(timeout=1) == "k1"
+    assert mx.workqueue_depth.value() == 1
+    q.done("k1")
+    q.shutdown()
+
+
+def test_workqueue_latency_histogram_observes_wait():
+    import time as _time
+
+    from tf_operator_tpu.runtime import metrics as mx
+    from tf_operator_tpu.runtime.workqueue import RateLimitingQueue
+
+    count_before = sum(mx.workqueue_latency_seconds._totals.values())
+    q = RateLimitingQueue()
+    q.add("k")
+    _time.sleep(0.01)
+    q.get(timeout=1)
+    q.done("k")
+    q.shutdown()
+    assert sum(mx.workqueue_latency_seconds._totals.values()) \
+        == count_before + 1
+
+
 # CI shard (pyproject [tool.pytest.ini_options] markers)
 pytestmark = pytest.mark.control_plane

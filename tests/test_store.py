@@ -116,5 +116,104 @@ def test_keys_returns_metadata_without_payload_copy():
     assert all(isinstance(rv, int) for rv in rvs)
     assert len(set(rvs)) == 3  # monotone resourceVersions, usable for age sort
 
+# --- indexes (control-plane scalability, ISSUE 2) -------------------------
+
+
+def _claim_selector(job):
+    from tf_operator_tpu.api import constants
+
+    return {constants.LABEL_GROUP_NAME: constants.GROUP,
+            constants.LABEL_JOB_NAME: job.metadata.name}
+
+
+def test_list_claimable_answers_from_indexes():
+    """Label-matching and owned-but-relabeled objects are returned;
+    other jobs' objects are not — all via the job-name/owner indexes."""
+    s = Store()
+    mine = testutil.new_tpujob(worker=3, name="mine")
+    other = testutil.new_tpujob(worker=3, name="other")
+    for i in range(3):
+        s.create(store_mod.PODS, testutil.new_pod(mine, "worker", i))
+        s.create(store_mod.PODS, testutil.new_pod(other, "worker", i))
+    # One owned pod whose job-name label was edited away: the release
+    # path must still see it.
+    relabeled = s.get(store_mod.PODS, "default", "mine-worker-0")
+    relabeled.metadata.labels["job-name"] = "somewhere-else"
+    s.update(store_mod.PODS, relabeled)
+
+    out = s.list_claimable(store_mod.PODS, "default",
+                           _claim_selector(mine), mine.metadata.uid)
+    names = {p.metadata.name for p in out}
+    assert names == {f"mine-worker-{i}" for i in range(3)}
+    assert all("other" not in n for n in names)
+
+
+def test_list_claimable_index_follows_updates_and_deletes():
+    s = Store()
+    job = testutil.new_tpujob(worker=2)
+    for i in range(2):
+        s.create(store_mod.PODS, testutil.new_pod(job, "worker", i))
+    s.delete(store_mod.PODS, "default",
+             testutil.new_pod(job, "worker", 0).metadata.name)
+    out = s.list_claimable(store_mod.PODS, "default",
+                           _claim_selector(job), job.metadata.uid)
+    assert len(out) == 1
+
+
+def test_list_claimable_returns_frozen_snapshots():
+    """Returned objects are the stored immutable snapshots themselves —
+    no per-sync deepcopy. A store write REPLACES the slot, so a held
+    snapshot never changes underneath the caller."""
+    s = Store()
+    job = testutil.new_tpujob(worker=1)
+    s.create(store_mod.PODS, testutil.new_pod(job, "worker", 0))
+    sel = _claim_selector(job)
+    first = s.list_claimable(store_mod.PODS, "default", sel,
+                             job.metadata.uid)
+    again = s.list_claimable(store_mod.PODS, "default", sel,
+                             job.metadata.uid)
+    assert first[0] is again[0]  # shared snapshot, not a copy
+    held = first[0]
+    held_rv = held.metadata.resource_version
+    update = held.deepcopy()
+    update.status.phase = "Running"
+    s.update(store_mod.PODS, update)
+    # The held snapshot is untouched; a fresh list sees the new slot.
+    assert held.metadata.resource_version == held_rv
+    assert held.status.phase != "Running"
+    fresh = s.list_claimable(store_mod.PODS, "default", sel,
+                             job.metadata.uid)
+    assert fresh[0] is not held
+    assert fresh[0].status.phase == "Running"
+
+
+def test_owned_keys_tracks_ownership():
+    s = Store()
+    job_a = testutil.new_tpujob(worker=2, name="a")
+    job_b = testutil.new_tpujob(worker=1, name="b")
+    for i in range(2):
+        s.create(store_mod.PODS, testutil.new_pod(job_a, "worker", i))
+    s.create(store_mod.PODS, testutil.new_pod(job_b, "worker", 0))
+    assert s.owned_keys(store_mod.PODS, job_a.metadata.uid) == [
+        ("default", "a-worker-0"), ("default", "a-worker-1")]
+    s.delete(store_mod.PODS, "default", "a-worker-0")
+    assert s.owned_keys(store_mod.PODS, job_a.metadata.uid) == [
+        ("default", "a-worker-1")]
+    assert s.owned_keys(store_mod.PODS, "no-such-uid") == []
+
+
+def test_owner_index_follows_release():
+    """Dropping the controller ownerReference (release) removes the
+    object from the owner index."""
+    s = Store()
+    job = testutil.new_tpujob(worker=1)
+    s.create(store_mod.PODS, testutil.new_pod(job, "worker", 0))
+    pod = s.get(store_mod.PODS, "default",
+                testutil.new_pod(job, "worker", 0).metadata.name)
+    pod.metadata.owner_references = []
+    s.update(store_mod.PODS, pod)
+    assert s.owned_keys(store_mod.PODS, job.metadata.uid) == []
+
+
 # CI shard (pyproject [tool.pytest.ini_options] markers)
 pytestmark = pytest.mark.control_plane

@@ -83,8 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--namespace", default=os.environ.get(
         "TPU_OPERATOR_NAMESPACE", ""),
         help="watch a single namespace ('' = all namespaces)")
-    p.add_argument("--threadiness", type=int, default=1,
-                   help="number of concurrent sync workers")
+    p.add_argument("--threadiness", type=int, default=4,
+                   help="number of concurrent sync workers (per-key "
+                        "serialization in the workqueue keeps parallel "
+                        "syncs safe; one job is never synced twice "
+                        "concurrently)")
     p.add_argument("--version", action="store_true",
                    help="print version and exit")
     p.add_argument("--json-log-format", dest="json_log", default=True,

@@ -86,8 +86,12 @@ class JobPlugin(abc.ABC):
 
     @abc.abstractmethod
     def update_job_status(self, job: TPUJob,
-                          replica_specs: Dict[str, ReplicaSpec]) -> None:
-        """Roll replica tallies into job conditions (success semantics)."""
+                          replica_specs: Dict[str, ReplicaSpec],
+                          pods: Optional[List[Pod]] = None) -> None:
+        """Roll replica tallies into job conditions (success semantics).
+        ``pods`` is the engine's already-listed+claimed snapshot —
+        implementations must use it instead of re-listing (one pod
+        list+claim per sync); None only for standalone callers."""
 
     @abc.abstractmethod
     def update_job_status_in_api(self, job: TPUJob) -> None:
@@ -266,7 +270,10 @@ class JobEngine:
             self.reconcile_pods(job, pods, rtype, spec, replica_specs)
             self.reconcile_endpoints(job, endpoints, rtype, spec)
 
-        self.plugin.update_job_status(job, replica_specs)
+        # Thread the snapshot this sync already listed+claimed through
+        # the status roll-up — update_job_status used to re-list and
+        # re-claim, doubling the per-sync store cost for nothing.
+        self.plugin.update_job_status(job, replica_specs, pods)
         if job.status.to_dict() != old_status.to_dict():
             self.plugin.update_job_status_in_api(job)
 
@@ -599,10 +606,17 @@ class JobEngine:
         if completion is None:
             log.warning("job %s finished but has no completion time", job.key())
             return
-        if _now() >= completion + _dt.timedelta(seconds=ttl):
+        expiry = completion + _dt.timedelta(seconds=ttl)
+        if _now() >= expiry:
             self.plugin.delete_job(job)
         else:
-            self.workqueue.add_rate_limited(job.key())
+            # Requeue after exactly the remaining TTL (reference
+            # job.go:345-357). add_rate_limited was wrong twice over:
+            # exponential backoff fires early-and-often (wasted syncs)
+            # and, past the cap, late (TTL overshoot) — and it grew the
+            # key's failure counter, eating into BackoffLimit.
+            remaining = (expiry - _now()).total_seconds()
+            self.workqueue.add_after(job.key(), remaining)
 
     def _past_active_deadline(self, job: TPUJob) -> bool:
         ads = job.spec.run_policy.active_deadline_seconds

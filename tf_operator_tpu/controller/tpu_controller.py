@@ -150,6 +150,10 @@ class TPUJobController(JobPlugin):
         self._watchers = []
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        # (job uid, rtype) -> (resourceVersion, digest). Single-writer
+        # per key (the workqueue serializes a job's syncs), so plain
+        # dict ops are safe at threadiness > 1.
+        self._hash_cache: Dict[tuple, tuple] = {}
 
     # ------------------------------------------------------------------
     # Informer handlers (reference controller.go:140-180, pod.go:73-214)
@@ -174,6 +178,8 @@ class TPUJobController(JobPlugin):
         elif event_type == DELETED:
             metrics.jobs_deleted.inc(job_namespace=job.metadata.namespace)
             self.expectations.delete_for_job(job.key())
+            for rt in list(job.spec.replica_specs):
+                self._hash_cache.pop((job.metadata.uid, rt.lower()), None)
             self._garbage_collect(job)
         self.enqueue(job.key())
 
@@ -182,14 +188,13 @@ class TPUJobController(JobPlugin):
         from the K8s ownerReference GC controller; the process-native store
         has no GC, so the controller reaps owned pods/endpoints/slicegroups
         when their job vanishes (pod deletion terminates the processes via
-        the backend's watch)."""
+        the backend's watch). O(owned) via the store's owner-UID index —
+        this used to be three full-namespace list() scans (deepcopying
+        every object in the namespace) per deleted job."""
         for kind in (store_mod.PODS, store_mod.ENDPOINTS,
                      store_mod.SLICEGROUPS):
-            for obj in self.store.list(kind, namespace=job.metadata.namespace):
-                ref = obj.metadata.controller_ref()
-                if ref is not None and ref.uid == job.metadata.uid:
-                    self.store.try_delete(kind, obj.metadata.namespace,
-                                          obj.metadata.name)
+            for ns, name in self.store.owned_keys(kind, job.metadata.uid):
+                self.store.try_delete(kind, ns, name)
 
     def _resolve_job_key(self, obj) -> Optional[str]:
         """Reference resolveControllerRef (job_controller.go:327-343):
@@ -250,8 +255,9 @@ class TPUJobController(JobPlugin):
         self.enqueue(job_key)
 
     def enqueue(self, job_key: str) -> None:
+        # Depth gauge + coalescing accounting live in the queue itself
+        # (one owner; the two racy set() call sites here are gone).
         self.workqueue.add(job_key)
-        metrics.workqueue_depth.set(len(self.workqueue))
 
     # ------------------------------------------------------------------
     # Worker loop (reference controller.go:191-284)
@@ -281,7 +287,6 @@ class TPUJobController(JobPlugin):
                 continue
             except ShutDown:
                 return
-            metrics.workqueue_depth.set(len(self.workqueue))
             try:
                 self.sync_tpujob(key)
             except Exception:
@@ -372,8 +377,10 @@ class TPUJobController(JobPlugin):
         labels stopped matching so the manager can release them
         (reference GetPodsForJob common/pod.go:219-254 +
         ControllerRefManager claim semantics). ``list_claimable``
-        filters store-side — selector match OR owned-by-this-job —
-        so unrelated objects are never deepcopied per sync."""
+        answers from the store's job-name/owner indexes and returns
+        FROZEN shared snapshots — O(owned) per sync with zero copies;
+        the claim pass deepcopies only the objects it actually mutates
+        (adopt/release edges)."""
         pods = self.store.list_claimable(
             store_mod.PODS, job.metadata.namespace,
             self._base_selector(job), job.metadata.uid)
@@ -395,6 +402,10 @@ class TPUJobController(JobPlugin):
           another controller — or nobody — can claim it; the pod itself
           is left alone)
         - someone else's         -> ignore
+
+        ``objs`` may be frozen store snapshots (list_claimable): the
+        common keep-path passes them through untouched, and the rare
+        adopt/release edges deepcopy before mutating.
         """
         selector = self._base_selector(job)
         claimed = []
@@ -405,6 +416,7 @@ class TPUJobController(JobPlugin):
             if ref is None:
                 if not matches or job.metadata.deletion_timestamp is not None:
                     continue
+                obj = obj.deepcopy()
                 obj.metadata.owner_references.append(controller_owner_ref(job))
                 obj = self._persist_adoption(kind, obj)
                 if obj is not None:
@@ -417,7 +429,7 @@ class TPUJobController(JobPlugin):
                     # A terminating job must NOT release: stripping the
                     # ownerReference mid-deletion would orphan the pod
                     # past every garbage collector, leaking it forever.
-                    self._persist_release(kind, obj, job)
+                    self._persist_release(kind, obj.deepcopy(), job)
             # else: owned by another controller -> leave it alone
         return claimed
 
@@ -452,8 +464,13 @@ class TPUJobController(JobPlugin):
                             f"Deleted job: {job.metadata.name}")
 
     def update_job_status(self, job: TPUJob,
-                          replica_specs: Dict[str, ReplicaSpec]) -> None:
-        pods = self.get_pods_for_job(job)
+                          replica_specs: Dict[str, ReplicaSpec],
+                          pods: Optional[List[Pod]] = None) -> None:
+        if pods is None:
+            # Standalone callers without a snapshot; the engine always
+            # passes the one it already listed+claimed — exactly one
+            # pod list per sync.
+            pods = self.get_pods_for_job(job)
         w0 = status_mod.is_worker0_completed(
             job, replica_specs, pods, self.get_default_container_name())
         status_mod.update_job_status(job, replica_specs, w0,
@@ -495,6 +512,23 @@ class TPUJobController(JobPlugin):
                 topo.devices_per_host)
 
     def bootstrap_hash(self, job: TPUJob, rtype: str, index: int) -> str:
+        """Cached world digest: the env render + sha1 is a pure function
+        of (job spec, rtype), so memoize per (job UID, rtype) keyed on
+        the job's resourceVersion — an idle resync (or a 256-pod gang
+        create, one call per pod) costs a dict hit instead of a JSON
+        render + digest. Any store write bumps the RV and invalidates;
+        entries die with the job (_on_job_event DELETED)."""
+        key = (job.metadata.uid, rtype.lower())
+        rv = job.metadata.resource_version
+        cached = self._hash_cache.get(key)
+        if cached is not None and cached[0] == rv:
+            return cached[1]
+        digest = self._compute_bootstrap_hash(job, rtype, index)
+        self._hash_cache[key] = (rv, digest)
+        return digest
+
+    def _compute_bootstrap_hash(self, job: TPUJob, rtype: str,
+                                index: int) -> str:
         """sha1 over the WORLD a pod of this rtype joins — deliberately
         index-invariant (every per-index env key is a pure function of
         (world, index), so for a fixed pod name the env changes iff the

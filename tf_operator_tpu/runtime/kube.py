@@ -1026,6 +1026,14 @@ class KubeJobController(TPUJobController):
     """TPUJobController with the write path against the K8s API server;
     the Store remains the read cache fed by KubeInformer."""
 
+    # Per-key serialization in the workqueue makes parallel sync workers
+    # safe; 4 is the production default (a 1k-job fleet converges ~4x
+    # faster through API-server write latency).
+    DEFAULT_THREADINESS = 4
+
+    def run(self, threadiness: int = DEFAULT_THREADINESS) -> None:
+        super().run(threadiness=threadiness)
+
     def __init__(self, client: KubeClient, store: Optional[Store] = None,
                  **kwargs):
         super().__init__(store or Store(), **kwargs)
@@ -1124,23 +1132,18 @@ class KubeJobController(TPUJobController):
     def _garbage_collect(self, job: TPUJob) -> None:
         """The cluster's ownerReference GC collects pods/services; delete
         explicitly too so tests (and clusters with GC lag) converge, and
-        reap the store-local SliceGroup."""
+        reap the store-local SliceGroup. O(owned) via the cache's
+        owner-UID index — this used to deepcopy every cached object in
+        the namespace, three kinds over, per deleted job."""
         for kind in (store_mod.PODS, store_mod.ENDPOINTS):
-            for obj in self.store.list(kind, namespace=job.metadata.namespace):
-                ref = obj.metadata.controller_ref()
-                if ref is not None and ref.uid == job.metadata.uid:
-                    try:
-                        self.client.delete(kind, obj.metadata.namespace,
-                                           obj.metadata.name)
-                    except store_mod.NotFoundError:
-                        pass
-        for obj in self.store.list(store_mod.SLICEGROUPS,
-                                   namespace=job.metadata.namespace):
-            ref = obj.metadata.controller_ref()
-            if ref is not None and ref.uid == job.metadata.uid:
-                self.store.try_delete(store_mod.SLICEGROUPS,
-                                      obj.metadata.namespace,
-                                      obj.metadata.name)
+            for ns, name in self.store.owned_keys(kind, job.metadata.uid):
+                try:
+                    self.client.delete(kind, ns, name)
+                except store_mod.NotFoundError:
+                    pass
+        for ns, name in self.store.owned_keys(store_mod.SLICEGROUPS,
+                                              job.metadata.uid):
+            self.store.try_delete(store_mod.SLICEGROUPS, ns, name)
 
 
 class KubeOperator:
@@ -1279,7 +1282,7 @@ class KubeOperator:
                 per_domain[dom] = per_domain.get(dom, 0) + n.spec.chips
         return max(per_domain.values(), default=None)
 
-    def start(self, threadiness: int = 2,
+    def start(self, threadiness: int = KubeJobController.DEFAULT_THREADINESS,
               sync_timeout: float = 30.0) -> None:
         for inf in self.informers:
             inf.start()

@@ -300,3 +300,18 @@ ready_latency_seconds = REGISTRY.histogram(
 workqueue_depth = REGISTRY.gauge(
     "tpu_operator_workqueue_depth",
     "Items waiting in the controller workqueue")
+workqueue_coalesced = REGISTRY.counter(
+    "tpu_operator_workqueue_coalesced_total",
+    "Enqueues coalesced into an already-pending key (event storms "
+    "collapsed into one sync)")
+workqueue_latency_seconds = REGISTRY.histogram(
+    "tpu_operator_workqueue_latency_seconds",
+    "Enqueue-to-dequeue wait of workqueue items (sync scheduling "
+    "latency)",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+             2.5, 5.0, 10.0, 30.0))
+events_aggregated = REGISTRY.counter(
+    "tpu_operator_events_aggregated_total",
+    "Recorder events folded into an existing event (duplicate count "
+    "bump or EventAggregator-style similar-event collapse) instead of "
+    "stored/posted individually")

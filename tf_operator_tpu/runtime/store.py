@@ -12,6 +12,19 @@ control loop runs hermetically:
   callbacks on a dispatcher thread per watcher (informer analog — objects
   are deep-copied both ways, preserving the informer-cache immutability
   discipline the reference relies on, controller.go:325).
+
+Scale discipline (the reconcile hot path syncs ~1k jobs x ~10k pods):
+
+- Two indexes are maintained on every write — per
+  ``(namespace, job-name label)`` and per controller-owner UID — so
+  ``list_claimable`` and ``owned_keys`` touch only a job's own objects
+  instead of scanning the namespace (client-go Indexer analog).
+- Stored objects are never mutated in place: every write deepcopies the
+  inbound object and REPLACES the slot, so a stored object is an
+  immutable snapshot from the moment it lands. ``list_claimable``
+  exploits that by returning the stored objects themselves (frozen;
+  callers deepcopy before mutating) instead of deepcopying the whole
+  claimed set on every sync.
 """
 
 from __future__ import annotations
@@ -26,6 +39,11 @@ from typing import Callable, Dict, List, Optional, Tuple
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+
+# The label both indexes and the controller's base selector key on
+# (api/constants.LABEL_JOB_NAME; duplicated literally — the store must
+# stay importable without the api package).
+INDEX_LABEL_JOB_NAME = "job-name"
 
 
 class ConflictError(Exception):
@@ -83,6 +101,37 @@ class Store:
         self._objects: Dict[str, Dict[Tuple[str, str], object]] = {}
         self._watchers: List[Watcher] = []
         self._rv = itertools.count(1)
+        # (kind, namespace, job-name label) -> {(ns, name), ...}
+        self._label_index: Dict[Tuple[str, str, str], set] = {}
+        # (kind, controller-owner uid) -> {(ns, name), ...}
+        self._owner_index: Dict[Tuple[str, str], set] = {}
+
+    # -- indexes (maintained under the lock on every write) ---------------
+
+    def _index_add(self, kind: str, key: Tuple[str, str], obj) -> None:
+        job_name = obj.metadata.labels.get(INDEX_LABEL_JOB_NAME)
+        if job_name:
+            self._label_index.setdefault(
+                (kind, key[0], job_name), set()).add(key)
+        ref = obj.metadata.controller_ref()
+        if ref is not None and ref.uid:
+            self._owner_index.setdefault((kind, ref.uid), set()).add(key)
+
+    def _index_remove(self, kind: str, key: Tuple[str, str], obj) -> None:
+        job_name = obj.metadata.labels.get(INDEX_LABEL_JOB_NAME)
+        if job_name:
+            bucket = self._label_index.get((kind, key[0], job_name))
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._label_index[(kind, key[0], job_name)]
+        ref = obj.metadata.controller_ref()
+        if ref is not None and ref.uid:
+            bucket = self._owner_index.get((kind, ref.uid))
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._owner_index[(kind, ref.uid)]
 
     # -- CRUD -------------------------------------------------------------
 
@@ -100,6 +149,7 @@ class Store:
                     _dt.timezone.utc)
             obj.metadata.resource_version = next(self._rv)
             coll[key] = obj
+            self._index_add(kind, key, obj)
             self._notify(kind, ADDED, obj)
             return obj.deepcopy()
 
@@ -151,21 +201,48 @@ class Store:
                        owner_uid: str) -> List[object]:
         """Objects a controller's claim pass must see: label matches OR
         already owned by ``owner_uid`` (covers owned objects whose
-        labels stopped matching, which release needs). Filters before
-        the deepcopy, so a namespace full of other jobs' pods costs
-        nothing (a full namespace list() would deepcopy every object
-        per job sync)."""
+        labels stopped matching, which release needs).
+
+        O(owned): candidates come from the job-name-label and owner-UID
+        indexes, so a namespace full of other jobs' pods costs nothing
+        (pre-index this scanned — and a full list() deepcopied — every
+        object in the namespace per job sync). Falls back to the scan
+        only for selectors without the indexed label.
+
+        Returns FROZEN shared snapshots, not copies: stored objects are
+        never mutated in place (every write replaces the slot), so the
+        only contract is on the caller — treat the result as immutable
+        and ``deepcopy()`` any object before mutating it (the claim
+        pass does exactly that on its rare adopt/release edges)."""
         with self._lock:
+            coll = self._objects.get(kind, {})
+            job_name = (selector or {}).get(INDEX_LABEL_JOB_NAME)
+            if job_name is None:
+                candidates = [k for k in coll if k[0] == namespace]
+            else:
+                keys = set(self._label_index.get(
+                    (kind, namespace, job_name), ()))
+                keys.update(self._owner_index.get((kind, owner_uid), ()))
+                candidates = sorted(keys)  # deterministic sync order
             out = []
-            for (ns, _), obj in self._objects.get(kind, {}).items():
-                if ns != namespace:
+            for key in candidates:
+                obj = coll.get(key)
+                if obj is None or key[0] != namespace:
                     continue
                 if not matches_selector(obj.metadata.labels, selector):
                     ref = obj.metadata.controller_ref()
                     if ref is None or ref.uid != owner_uid:
                         continue
-                out.append(obj.deepcopy())
+                out.append(obj)
             return out
+
+    def owned_keys(self, kind: str, owner_uid: str) -> List[Tuple[str, str]]:
+        """(namespace, name) keys of objects whose controller
+        ownerReference is ``owner_uid`` — O(owned) via the owner index,
+        no payload copies. The garbage-collection primitive: cascade
+        deletes used to re-list (and deepcopy) whole namespaces."""
+        with self._lock:
+            return sorted(self._owner_index.get((kind, owner_uid), ()))
 
     def update(self, kind: str, obj) -> object:
         """Full-object update with optimistic concurrency: the caller's
@@ -187,7 +264,9 @@ class Store:
             obj.metadata.uid = current.metadata.uid
             obj.metadata.creation_timestamp = current.metadata.creation_timestamp
             obj.metadata.resource_version = next(self._rv)
+            self._index_remove(kind, key, current)
             coll[key] = obj
+            self._index_add(kind, key, obj)
             self._notify(kind, MODIFIED, obj)
             return obj.deepcopy()
 
@@ -203,6 +282,8 @@ class Store:
             stored = current.deepcopy()
             stored.status = obj.status.deepcopy()
             stored.metadata.resource_version = next(self._rv)
+            # No index maintenance: a status merge cannot change the
+            # labels/ownerRefs the (key-valued) indexes are built from.
             coll[key] = stored
             self._notify(kind, MODIFIED, stored)
             return stored.deepcopy()
@@ -213,6 +294,7 @@ class Store:
             obj = coll.pop((namespace, name), None)
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            self._index_remove(kind, (namespace, name), obj)
             self._notify(kind, DELETED, obj)
 
     def try_delete(self, kind: str, namespace: str, name: str) -> bool:

@@ -3,15 +3,25 @@
 Reference dependency: k8s.io/client-go/util/workqueue as used by
 job_controller.go:139-142. Semantics preserved:
 
-- De-duplication: an item present in the queue is not added twice.
+- De-duplication: an item present in the queue is not added twice
+  (coalescing — a 256-pod gang start collapses its event storm into
+  one pending sync per job; counted by ``workqueue_coalesced_total``).
 - In-flight marking: an item re-added while being processed is deferred
   until ``done`` and then requeued (level-triggered, same-key serialized —
-  this is the engine's only concurrency-safety requirement).
+  this is the engine's only concurrency-safety requirement, and what
+  makes ``threadiness > 1`` safe: two workers can never hold the same
+  key simultaneously).
 - ``add_rate_limited`` applies per-item exponential backoff;
   ``num_requeues`` feeds the engine's BackoffLimit policy;
   ``forget`` resets the counter.
 - ``add_after`` schedules a delayed add (used for ActiveDeadlineSeconds
-  re-sync, reference status.go:84-92).
+  and TTL re-sync, reference status.go:84-92, job.go:345-357).
+
+Observability lives HERE, under the queue's own lock (the depth gauge
+used to be set racily at the two controller call sites):
+``workqueue_depth`` on every transition, ``workqueue_latency_seconds``
+(add -> get wait) on every pop, ``workqueue_coalesced_total`` on every
+deduplicated add.
 """
 
 from __future__ import annotations
@@ -22,37 +32,62 @@ import time
 from collections import deque
 from typing import Dict, Hashable, List, Optional, Tuple
 
+from tf_operator_tpu.runtime import metrics
+
 
 class ShutDown(Exception):
     pass
 
 
 class RateLimitingQueue:
-    def __init__(self, base_delay: float = 0.005, max_delay: float = 30.0):
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 30.0,
+                 instrument: bool = True):
         self._lock = threading.Condition()
         self._queue: deque = deque()
         self._dirty: set = set()
         self._processing: set = set()
         self._failures: Dict[Hashable, int] = {}
+        self._added_at: Dict[Hashable, float] = {}
         self._delayed: List[Tuple[float, int, Hashable]] = []  # heap
         self._seq = 0
         self._shutting_down = False
         self._base_delay = base_delay
         self._max_delay = max_delay
+        # Process-global metrics; tests that build throwaway queues can
+        # opt out so they don't scribble on the operator's gauges.
+        self._instrument = instrument
         self._delay_thread = threading.Thread(target=self._delay_loop,
                                               daemon=True)
         self._delay_thread.start()
+
+    # -- instrumentation (callers hold self._lock) -------------------------
+
+    def _mark_queued(self, item: Hashable) -> None:
+        self._queue.append(item)
+        self._added_at.setdefault(item, time.monotonic())
+        self._set_depth()
+
+    def _set_depth(self) -> None:
+        if self._instrument:
+            metrics.workqueue_depth.set(len(self._queue))
+
+    def _coalesced(self) -> None:
+        if self._instrument:
+            metrics.workqueue_coalesced.inc()
 
     # -- core queue -------------------------------------------------------
 
     def add(self, item: Hashable) -> None:
         with self._lock:
-            if self._shutting_down or item in self._dirty:
+            if self._shutting_down:
+                return
+            if item in self._dirty:
+                self._coalesced()
                 return
             self._dirty.add(item)
             if item in self._processing:
                 return  # re-queued by done()
-            self._queue.append(item)
+            self._mark_queued(item)
             self._lock.notify()
 
     def get(self, timeout: Optional[float] = None) -> Hashable:
@@ -70,13 +105,18 @@ class RateLimitingQueue:
             item = self._queue.popleft()
             self._processing.add(item)
             self._dirty.discard(item)
+            added = self._added_at.pop(item, None)
+            if added is not None and self._instrument:
+                metrics.workqueue_latency_seconds.observe(
+                    time.monotonic() - added)
+            self._set_depth()
             return item
 
     def done(self, item: Hashable) -> None:
         with self._lock:
             self._processing.discard(item)
             if item in self._dirty:
-                self._queue.append(item)
+                self._mark_queued(item)
                 self._lock.notify()
 
     def __len__(self) -> int:
@@ -133,8 +173,10 @@ class RateLimitingQueue:
                     if item not in self._dirty:
                         self._dirty.add(item)
                         if item not in self._processing:
-                            self._queue.append(item)
+                            self._mark_queued(item)
                             self._lock.notify()
+                    else:
+                        self._coalesced()
                 wait = 0.2
                 if self._delayed:
                     wait = min(wait, max(0.0, self._delayed[0][0] - now))

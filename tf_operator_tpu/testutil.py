@@ -195,11 +195,12 @@ class StubPlugin(JobPlugin):
     def delete_job(self, job):
         self.deleted_jobs.append(job.metadata.name)
 
-    def update_job_status(self, job, replica_specs):
+    def update_job_status(self, job, replica_specs, pods=None):
         from tf_operator_tpu.controller import status as status_mod
 
+        pods = self.pods if pods is None else pods
         w0 = status_mod.is_worker0_completed(
-            job, replica_specs, self.pods, self.get_default_container_name())
+            job, replica_specs, pods, self.get_default_container_name())
         status_mod.update_job_status(job, replica_specs, w0,
                                      workqueue=self.workqueue)
 
