@@ -66,12 +66,27 @@ NAMESPACE = "bench"
 class FakeKubelet(threading.Thread):
     """Drives pod phases like a node agent: every tick, Pending pods
     start Running and Running pods complete with exit 0. One phase per
-    tick so the controller observes the full lifecycle churn."""
+    tick so the controller observes the full lifecycle churn.
 
-    def __init__(self, store: Store, tick: float = 0.01):
+    ``admitted``: optional (namespace, job_name) -> bool gate — the
+    gang-gated data-plane analog for the tenant scenario: a Pending pod
+    only starts once its SliceGroup is admitted (without it, pods of
+    quota-held gangs would run anyway and the contention measurement
+    would be fiction).
+
+    ``min_run_seconds``: hold Running pods at least this long before
+    completing them — the tenant scenario needs borrowers still
+    RUNNING when the late tenant's nominal demand arrives, or there is
+    nothing to reclaim."""
+
+    def __init__(self, store: Store, tick: float = 0.01, admitted=None,
+                 min_run_seconds: float = 0.0):
         super().__init__(name="fake-kubelet", daemon=True)
         self.store = store
         self.tick = tick
+        self.admitted = admitted
+        self.min_run_seconds = min_run_seconds
+        self._run_since: Dict[Tuple[str, str], float] = {}
         self._stop = threading.Event()
 
     def stop(self) -> None:
@@ -82,16 +97,27 @@ class FakeKubelet(threading.Thread):
             transitions = self.store.project(
                 store_mod.PODS,
                 lambda p: ((p.metadata.namespace, p.metadata.name,
-                            p.status.phase)
+                            p.status.phase,
+                            p.metadata.labels.get(
+                                constants.LABEL_JOB_NAME, ""))
                            if p.status.phase in (PodPhase.PENDING,
                                                  PodPhase.RUNNING)
                            else None),
                 namespace=NAMESPACE)
-            for ns, name, phase in transitions:
+            now = time.perf_counter()
+            for ns, name, phase, job_name in transitions:
                 patch = Pod(metadata=ObjectMeta(name=name, namespace=ns))
                 if phase == PodPhase.PENDING:
+                    if (self.admitted is not None
+                            and not self.admitted(ns, job_name)):
+                        continue  # gang gate: held until admission
+                    self._run_since[(ns, name)] = now
                     patch.status = PodStatus(phase=PodPhase.RUNNING,
                                              start_time=testutil.now())
+                elif (self.min_run_seconds
+                        and now - self._run_since.get((ns, name), 0.0)
+                        < self.min_run_seconds):
+                    continue  # still inside its minimum runtime
                 else:
                     patch.status = PodStatus(
                         phase=PodPhase.SUCCEEDED,
@@ -213,6 +239,166 @@ def run_bench(jobs: int, workers: int, threadiness: int,
     }
 
 
+def run_tenant_bench(tenants: int, jobs_per_tenant: int, workers: int,
+                     threadiness: int, timeout: float,
+                     chips_per_job: int = 4,
+                     kubelet_tick: float = 0.01,
+                     stagger: float = 0.2) -> Dict:
+    """Multi-tenant contention scenario: ``tenants`` queues over ONE
+    cohort, each with nominal quota for exactly one job, all submitting
+    ``jobs_per_tenant`` jobs. Tenants 0..N-2 submit at t0 and borrow
+    the idle cohort capacity; the LAST tenant submits ``stagger``
+    seconds later, so its nominal demand arrives against a fully
+    borrowed cohort and must be satisfied by reclaim preemptions.
+
+    Reports per-queue admission wait (job submit -> SliceGroup
+    Inqueue) and reclaim counts on top of the run_bench-style
+    convergence numbers."""
+    from tf_operator_tpu.api.types import (
+        ClusterQueue,
+        ClusterQueueSpec,
+        TenantQueue,
+        TenantQueueSpec,
+    )
+    from tf_operator_tpu.controller.engine import EngineConfig
+    from tf_operator_tpu.controller.gang import (
+        PHASE_INQUEUE,
+        PHASE_RUNNING,
+        SliceGangScheduler,
+    )
+    from tf_operator_tpu.controller.quota import TenantQueueManager
+    from tf_operator_tpu.runtime import metrics
+
+    store = Store()
+    total_chips = tenants * chips_per_job
+    quota = TenantQueueManager(store)
+    gang = SliceGangScheduler(store, total_chips=total_chips, quota=quota)
+    controller = TPUJobController(
+        store, config=EngineConfig(enable_gang_scheduling=True),
+        gang=gang, namespace=NAMESPACE)
+    queues = [f"tenant-{t}" for t in range(tenants)]
+    for q in queues:
+        cq = ClusterQueue(spec=ClusterQueueSpec(
+            nominal_chips=chips_per_job, cohort="bench"))
+        cq.metadata.name = f"cq-{q}"
+        cq.metadata.namespace = ""
+        store.create(store_mod.CLUSTERQUEUES, cq)
+        tq = TenantQueue(spec=TenantQueueSpec(cluster_queue=f"cq-{q}"))
+        tq.metadata.name = q
+        tq.metadata.namespace = NAMESPACE
+        store.create(store_mod.TENANTQUEUES, tq)
+
+    def group_admitted(ns: str, job_name: str) -> bool:
+        g = store.try_get(store_mod.SLICEGROUPS, ns, job_name)
+        return g is not None and g.status.phase in (PHASE_INQUEUE,
+                                                    PHASE_RUNNING)
+
+    timer = _SyncTimer(controller)
+    # Borrowers must still be running when the late tenant's demand
+    # arrives, or there is nothing to reclaim; the wide margin keeps
+    # the reclaim deterministic on slow shared CI.
+    kubelet = FakeKubelet(store, tick=kubelet_tick,
+                          admitted=group_admitted,
+                          min_run_seconds=stagger + 1.0)
+
+    # submit time per job + first-Inqueue time per group, for the
+    # per-queue admission-wait numbers (wall clock, one process).
+    submit_t: Dict[str, float] = {}
+    inqueue_t: Dict[str, float] = {}
+    inqueue_lock = threading.Lock()
+
+    def on_group_event(event_type: str, group) -> None:
+        if group.status.phase in (PHASE_INQUEUE, PHASE_RUNNING):
+            with inqueue_lock:
+                inqueue_t.setdefault(group.metadata.name,
+                                     time.perf_counter())
+
+    watcher = store.watch(store_mod.SLICEGROUPS, on_group_event)
+    reclaims_before = {q: metrics.quota_reclaims.value(queue=q)
+                       for q in queues}
+
+    def submit(tenant: int, index: int) -> None:
+        q = queues[tenant]
+        name = f"bench-{tenant:02d}-{index:03d}"
+        job = testutil.new_tpujob(worker=workers, name=name,
+                                  namespace=NAMESPACE)
+        job.spec.slice.accelerator = f"v5e-{chips_per_job}"
+        job.spec.queue_name = q
+        submit_t[name] = time.perf_counter()
+        store.create(store_mod.TPUJOBS, job)
+
+    controller.run(threadiness=threadiness)
+    kubelet.start()
+    t0 = time.perf_counter()
+    total_jobs = tenants * jobs_per_tenant
+    try:
+        for t in range(tenants - 1):
+            for i in range(jobs_per_tenant):
+                submit(t, i)
+        time.sleep(stagger)  # the late tenant's demand forces reclaim
+        for i in range(jobs_per_tenant):
+            submit(tenants - 1, i)
+
+        deadline = t0 + timeout
+        while True:
+            succeeded = sum(store.project(
+                store_mod.TPUJOBS,
+                lambda j: 1 if cond.is_succeeded(j.status) else None,
+                namespace=NAMESPACE))
+            if succeeded >= total_jobs:
+                break
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"{succeeded}/{total_jobs} jobs Succeeded after "
+                    f"{timeout}s")
+            time.sleep(0.02)
+        convergence = time.perf_counter() - t0
+    finally:
+        kubelet.stop()
+        controller.stop()
+        watcher.stop()
+        store.stop_watchers()
+
+    per_queue = {}
+    reclaims_total = 0
+    for t, q in enumerate(queues):
+        waits = []
+        for i in range(jobs_per_tenant):
+            name = f"bench-{t:02d}-{i:03d}"
+            if name in submit_t and name in inqueue_t:
+                waits.append(inqueue_t[name] - submit_t[name])
+        reclaims = int(metrics.quota_reclaims.value(queue=q)
+                       - reclaims_before[q])
+        reclaims_total += reclaims
+        per_queue[q] = {
+            "jobs": jobs_per_tenant,
+            "admission_wait_mean_ms": round(
+                sum(waits) / len(waits) * 1e3, 3) if waits else None,
+            "admission_wait_max_ms": round(
+                max(waits) * 1e3, 3) if waits else None,
+            "reclaims": reclaims,
+        }
+    durations = timer.snapshot()
+    syncs = len(durations)
+    return {
+        "convergence_seconds": round(convergence, 3),
+        "jobs_per_sec": round(total_jobs / convergence, 2),
+        "syncs": syncs,
+        "reconcile_p50_ms": round(_percentile(durations, 0.50) * 1e3, 3),
+        "reconcile_p99_ms": round(_percentile(durations, 0.99) * 1e3, 3),
+        "tenants": tenants,
+        "jobs_per_tenant": jobs_per_tenant,
+        "jobs": total_jobs,
+        "workers_per_job": workers,
+        "pods": total_jobs * workers,
+        "chips_per_job": chips_per_job,
+        "cohort_chips": total_chips,
+        "threadiness": threadiness,
+        "reclaims_total": reclaims_total,
+        "per_queue": per_queue,
+    }
+
+
 def _environment() -> Dict:
     """Environment fingerprint fields (auditable round-over-round):
     jax version + platform/chip kind when jax is importable, host facts
@@ -244,22 +430,47 @@ def config_fingerprint(config: Dict) -> str:
 
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--jobs", type=int, default=200)
+    p.add_argument("--jobs", type=int, default=200,
+                   help="total jobs (plain scenario) or jobs PER TENANT "
+                        "(--tenants scenario)")
     p.add_argument("--workers", type=int, default=16)
     p.add_argument("--threadiness", type=int, default=4)
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--kubelet-tick", type=float, default=0.01)
+    p.add_argument("--tenants", type=int, default=0,
+                   help="N>0 switches to the multi-tenant contention "
+                        "scenario: N tenant queues over one cohort, "
+                        "gang admission + quota on, per-queue "
+                        "admission-wait and reclaim counts in the "
+                        "artifact")
+    p.add_argument("--chips-per-job", type=int, default=4,
+                   help="(--tenants) slice size per job = per-queue "
+                        "nominal quota")
     args = p.parse_args(argv)
 
     config = {"jobs": args.jobs, "workers": args.workers,
               "threadiness": args.threadiness,
               "kubelet_tick": args.kubelet_tick}
+    if args.tenants > 0:
+        config.update({"tenants": args.tenants,
+                       "chips_per_job": args.chips_per_job})
+        metric = (f"controlplane_tenant_convergence_jobs_per_sec"
+                  f"[{args.tenants}t x {args.jobs}x{args.workers}]")
+    else:
+        metric = (f"controlplane_convergence_jobs_per_sec"
+                  f"[{args.jobs}x{args.workers}]")
     try:
-        result = run_bench(args.jobs, args.workers, args.threadiness,
-                           args.timeout, kubelet_tick=args.kubelet_tick)
+        if args.tenants > 0:
+            result = run_tenant_bench(
+                args.tenants, args.jobs, args.workers, args.threadiness,
+                args.timeout, chips_per_job=args.chips_per_job,
+                kubelet_tick=args.kubelet_tick)
+        else:
+            result = run_bench(args.jobs, args.workers, args.threadiness,
+                               args.timeout,
+                               kubelet_tick=args.kubelet_tick)
         print(json.dumps({
-            "metric": (f"controlplane_convergence_jobs_per_sec"
-                       f"[{args.jobs}x{args.workers}]"),
+            "metric": metric,
             "value": result["jobs_per_sec"],
             "unit": "jobs/sec",
             **result,
@@ -269,7 +480,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     except Exception as e:  # one JSON line, even on failure
         print(json.dumps({
-            "metric": "controlplane_convergence_jobs_per_sec",
+            "metric": metric,
             "value": 0.0,
             "unit": "jobs/sec",
             "error": f"{type(e).__name__}: {e}",

@@ -20,7 +20,9 @@ HEADER = """# TPUJob API reference
 Wire format: camelCase JSON/YAML (K8s convention); machine-readable
 schema at `manifests/base/tpujob.schema.json`. Semantic rules beyond
 types (required containers, replica bounds, name formats) live in
-`tf_operator_tpu/api/validation.py`.
+`tf_operator_tpu/api/validation.py`. The TenantQueue/ClusterQueue
+quota kinds (cohort semantics, borrowing, reclaim) are documented in
+`docs/quota.md`.
 """
 
 
@@ -39,19 +41,28 @@ def _fmt_type(prop: dict) -> str:
 
 
 def render() -> str:
-    schema = generate_schema()
+    from tf_operator_tpu.api.types import ClusterQueue, TenantQueue
+
     lines = [HEADER]
+    emitted = set()
 
     def emit(name: str, obj: dict):
+        if name in emitted:
+            return
+        emitted.add(name)
         lines.append(f"\n## {name}\n")
         lines.append("| Field | Type |")
         lines.append("|---|---|")
         for field, prop in obj.get("properties", {}).items():
             lines.append(f"| `{field}` | {_fmt_type(prop)} |")
 
-    emit(schema["title"], schema)
-    for name, obj in schema.get("$defs", {}).items():
-        emit(name, obj)
+    # TPUJob first (the headline kind), then the tenant-queue admission
+    # kinds; shared $defs (ObjectMeta etc.) are emitted once.
+    for cls in (None, TenantQueue, ClusterQueue):
+        schema = generate_schema(cls)
+        emit(schema["title"], schema)
+        for name, obj in schema.get("$defs", {}).items():
+            emit(name, obj)
     return "\n".join(lines) + "\n"
 
 

@@ -504,6 +504,10 @@ class TestAuthAndTls:
 class TestTls:
     @pytest.fixture
     def tls_files(self, tmp_path):
+        # cert generation needs the optional 'cryptography' extra
+        # (pyproject [tls]); without it these four tests SKIP instead
+        # of erroring — tlsutil itself imports it lazily.
+        pytest.importorskip("cryptography")
         from tf_operator_tpu.runtime.tlsutil import ensure_self_signed
 
         cert, key = str(tmp_path / "cert.pem"), str(tmp_path / "key.pem")

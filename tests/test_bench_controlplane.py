@@ -63,6 +63,35 @@ def test_artifact_is_one_json_line_with_pinned_schema(capsys):
              "kubelet_tick": 0.01}))
 
 
+def test_tenant_scenario_smoke_and_artifact_schema(capsys):
+    """--tenants N contention scenario: N queues over one cohort with
+    gang+quota on; the artifact carries per-queue admission-wait and
+    reclaim counts. The late tenant's nominal demand lands against a
+    fully borrowed cohort, so at least one reclaim must fire."""
+    rc = bench_controlplane.main(["--tenants", "3", "--jobs", "2",
+                                  "--workers", "2", "--timeout", "60"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "artifact must be exactly one line"
+    artifact = json.loads(out[0])
+    assert artifact["metric"].startswith(
+        "controlplane_tenant_convergence_jobs_per_sec")
+    assert artifact["tenants"] == 3
+    assert artifact["jobs"] == 6
+    assert set(artifact["per_queue"]) == {"tenant-0", "tenant-1",
+                                          "tenant-2"}
+    for stats in artifact["per_queue"].values():
+        assert {"jobs", "admission_wait_mean_ms", "admission_wait_max_ms",
+                "reclaims"} <= set(stats)
+        assert stats["admission_wait_mean_ms"] is not None
+    assert artifact["reclaims_total"] >= 1
+    assert artifact["reclaims_total"] == sum(
+        s["reclaims"] for s in artifact["per_queue"].values())
+    # The late tenant waits measurably longer than the head-start ones.
+    assert (artifact["per_queue"]["tenant-2"]["admission_wait_mean_ms"]
+            > 0)
+
+
 def test_failure_still_emits_one_json_line(capsys):
     # Impossible timeout: the artifact contract holds on failure too.
     rc = bench_controlplane.main(["--jobs", "2", "--workers", "1",

@@ -8,6 +8,9 @@ from tf_operator_tpu.api import constants  # noqa: F401
 from tf_operator_tpu.api.defaults import set_defaults  # noqa: F401
 from tf_operator_tpu.api.types import (  # noqa: F401
     CleanPodPolicy,
+    ClusterQueue,
+    ClusterQueueSpec,
+    ClusterQueueStatus,
     ConditionStatus,
     Container,
     Endpoint,
@@ -22,6 +25,7 @@ from tf_operator_tpu.api.types import (  # noqa: F401
     PodSpec,
     PodStatus,
     PodTemplateSpec,
+    ReclaimPolicy,
     ReplicaSpec,
     ReplicaStatus,
     ReplicaType,
@@ -31,6 +35,9 @@ from tf_operator_tpu.api.types import (  # noqa: F401
     SliceGroup,
     SliceGroupSpec,
     SuccessPolicy,
+    TenantQueue,
+    TenantQueueSpec,
+    TenantQueueStatus,
     TPUJob,
     TPUJobSpec,
     TPUSliceSpec,
@@ -39,4 +46,9 @@ from tf_operator_tpu.api.types import (  # noqa: F401
     is_evaluator,
     is_worker,
 )
-from tf_operator_tpu.api.validation import ValidationError, validate_job  # noqa: F401
+from tf_operator_tpu.api.validation import (  # noqa: F401
+    ValidationError,
+    validate_cluster_queue,
+    validate_job,
+    validate_tenant_queue,
+)

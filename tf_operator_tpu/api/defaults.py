@@ -14,6 +14,8 @@ from __future__ import annotations
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import (
     CleanPodPolicy,
+    ClusterQueue,
+    ReclaimPolicy,
     ReplicaSpec,
     RestartPolicy,
     TPUJob,
@@ -64,3 +66,15 @@ def set_defaults(job: TPUJob) -> TPUJob:
             spec.restart_policy = DEFAULT_RESTART_POLICY
         _set_default_port(spec)
     return job
+
+
+def set_cluster_queue_defaults(cq: ClusterQueue) -> ClusterQueue:
+    """Mutates ``cq`` in place and returns it (controller/quota.py):
+    a queue with no cohort is a cohort of one (no lending, no
+    borrowing), and reclaim defaults to Any — borrowed capacity is a
+    loan, not a grant."""
+    if not cq.spec.cohort:
+        cq.spec.cohort = cq.metadata.name
+    if not cq.spec.reclaim_policy:
+        cq.spec.reclaim_policy = ReclaimPolicy.ANY
+    return cq

@@ -94,6 +94,11 @@ class SuccessPolicy:
 
 class JobConditionType:
     CREATED = "Created"
+    # TPU extension (controller/quota.py): the job's gang is held by
+    # tenant-queue quota, not by physical capacity. Flips to status
+    # False on admission; no reference analog (the reference had no
+    # admission control of its own).
+    QUEUED = "Queued"
     RUNNING = "Running"
     RESTARTING = "Restarting"
     SUCCEEDED = "Succeeded"
@@ -372,6 +377,12 @@ class TPUJobSpec(ApiObject):
     # types.go:66-67).
     enable_elastic_worker: bool = False
     slice: TPUSliceSpec = field(default_factory=TPUSliceSpec)
+    # Multi-tenant admission: the TenantQueue (same namespace) this job's
+    # SliceGroup admits through (controller/quota.py; Kueue
+    # workload-queueing analog). '' = the default queue — quota-exempt,
+    # preserving pre-quota admission behavior. With tenant queues
+    # disabled the field is carried but inert.
+    queue_name: str = ""
 
 
 # ---------------------------------------------------------------------------
@@ -458,6 +469,95 @@ class SliceGroup(ApiObject):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: SliceGroupSpec = field(default_factory=SliceGroupSpec)
     status: SliceGroupStatus = field(default_factory=SliceGroupStatus)
+
+
+# ---------------------------------------------------------------------------
+# TenantQueue / ClusterQueue: multi-tenant quota & fair-share queueing
+# (controller/quota.py). Kueue LocalQueue/ClusterQueue analog, collapsed
+# to the chip-count resource model the gang scheduler already admits in:
+# a TenantQueue is the namespaced handle jobs reference via
+# spec.queueName; a ClusterQueue carries the chip quota and cohort
+# membership that decide admission *eligibility* (the gang scheduler
+# still decides physical fit, the binder still places).
+# ---------------------------------------------------------------------------
+
+class ReclaimPolicy:
+    """How a ClusterQueue gets its nominal quota back from cohort
+    borrowers when its own workloads demand it (Kueue
+    reclaimWithinCohort analog).
+
+    NEVER:          wait for borrowers to finish voluntarily.
+    LOWER_PRIORITY: reclaim only from borrowed groups with strictly
+                    lower priority than the demanding group.
+    ANY (default):  reclaim from any borrowed group, lowest priority /
+                    youngest first.
+    """
+
+    NEVER = "Never"
+    LOWER_PRIORITY = "LowerPriority"
+    ANY = "Any"
+
+    ALL = (NEVER, LOWER_PRIORITY, ANY)
+
+
+@dataclasses.dataclass
+class TenantQueueSpec(ApiObject):
+    # Name of the cluster-scoped ClusterQueue this queue admits through.
+    cluster_queue: str = ""
+
+
+@dataclasses.dataclass
+class TenantQueueStatus(ApiObject):
+    # Groups of this queue currently waiting for quota or capacity.
+    pending_groups: int = 0
+    # Chips currently admitted through this queue.
+    admitted_chips: int = 0
+
+
+@dataclasses.dataclass
+class TenantQueue(ApiObject):
+    api_version: str = constants.API_VERSION
+    kind: str = "TenantQueue"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TenantQueueSpec = field(default_factory=TenantQueueSpec)
+    status: TenantQueueStatus = field(default_factory=TenantQueueStatus)
+
+
+@dataclasses.dataclass
+class ClusterQueueSpec(ApiObject):
+    # Chips this queue owns outright: admission below nominal is always
+    # quota-eligible (physical fit permitting).
+    nominal_chips: int = 0
+    # Extra chips this queue may hold ABOVE nominal by borrowing idle
+    # cohort capacity. None = unlimited borrowing (bounded by the
+    # cohort's aggregate nominal); 0 = borrowing off.
+    borrowing_limit: Optional[int] = None
+    # See ReclaimPolicy; defaulted to ANY (api/defaults.py).
+    reclaim_policy: str = ""
+    # Queues sharing a cohort lend each other idle nominal capacity.
+    # Defaulted to the queue's own name (a cohort of one = no sharing).
+    cohort: str = ""
+
+
+@dataclasses.dataclass
+class ClusterQueueStatus(ApiObject):
+    admitted_chips: int = 0
+    # Portion of admitted_chips above nominal (borrowed from the cohort).
+    borrowed_chips: int = 0
+    pending_groups: int = 0
+
+
+@dataclasses.dataclass
+class ClusterQueue(ApiObject):
+    """Cluster-scoped (the store files it under the reserved namespace
+    '' — no user namespace owns a ClusterQueue)."""
+
+    api_version: str = constants.API_VERSION
+    kind: str = "ClusterQueue"
+    metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(
+        namespace=""))
+    spec: ClusterQueueSpec = field(default_factory=ClusterQueueSpec)
+    status: ClusterQueueStatus = field(default_factory=ClusterQueueStatus)
 
 
 # ---------------------------------------------------------------------------

@@ -15,9 +15,12 @@ from typing import List
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import (
     CleanPodPolicy,
+    ClusterQueue,
+    ReclaimPolicy,
     ReplicaType,
     RestartPolicy,
     SuccessPolicy,
+    TenantQueue,
     TPUJob,
     TPUJobSpec,
     is_chief_or_master,
@@ -99,6 +102,10 @@ def _spec_errors(spec: TPUJobSpec):
     if ttl is not None and ttl < 0:
         yield "spec.runPolicy.ttlSecondsAfterFinished must be >= 0"
 
+    if spec.queue_name and not _NAME_RE.match(spec.queue_name):
+        yield (f"spec.queueName {spec.queue_name!r} must be a lowercase "
+               "RFC-1123 label (alphanumerics and '-')")
+
     yield from _slice_errors(spec)
 
 
@@ -139,6 +146,55 @@ def _slice_errors(spec: TPUJobSpec):
                "'2x2x4'")
     if sl.num_slices < 1:
         yield "spec.slice.numSlices must be >= 1"
+
+
+def validate_tenant_queue(tq: TenantQueue) -> None:
+    """TenantQueue admission-config validation (controller/quota.py):
+    both names are RFC-1123 labels; the ClusterQueue reference is
+    required (an unreferenced TenantQueue admits nothing and would
+    silently behave like the default queue)."""
+    errors: List[str] = []
+    if not tq.metadata.name:
+        errors.append("metadata.name must be set")
+    elif not _NAME_RE.match(tq.metadata.name):
+        errors.append(f"metadata.name {tq.metadata.name!r} must be a "
+                      "lowercase RFC-1123 label")
+    if not tq.spec.cluster_queue:
+        errors.append("spec.clusterQueue must name a ClusterQueue")
+    elif not _NAME_RE.match(tq.spec.cluster_queue):
+        errors.append(f"spec.clusterQueue {tq.spec.cluster_queue!r} must "
+                      "be a lowercase RFC-1123 label")
+    if errors:
+        raise ValidationError(errors)
+
+
+def validate_cluster_queue(cq: ClusterQueue) -> None:
+    """ClusterQueue quota validation: non-negative chip counts, known
+    reclaim policy, RFC-1123 names. ('' reclaimPolicy/cohort are legal
+    pre-defaulting inputs — api/defaults.set_cluster_queue_defaults
+    fills them.)"""
+    errors: List[str] = []
+    if not cq.metadata.name:
+        errors.append("metadata.name must be set")
+    elif not _NAME_RE.match(cq.metadata.name):
+        errors.append(f"metadata.name {cq.metadata.name!r} must be a "
+                      "lowercase RFC-1123 label")
+    if cq.spec.nominal_chips < 0:
+        errors.append("spec.nominalChips must be >= 0")
+    bl = cq.spec.borrowing_limit
+    if bl is not None and bl < 0:
+        errors.append("spec.borrowingLimit must be >= 0 (or omitted for "
+                      "unlimited cohort borrowing)")
+    if (cq.spec.reclaim_policy
+            and cq.spec.reclaim_policy not in ReclaimPolicy.ALL):
+        errors.append(
+            f"spec.reclaimPolicy {cq.spec.reclaim_policy!r} invalid; "
+            f"expected one of {', '.join(ReclaimPolicy.ALL)}")
+    if cq.spec.cohort and not _NAME_RE.match(cq.spec.cohort):
+        errors.append(f"spec.cohort {cq.spec.cohort!r} must be a "
+                      "lowercase RFC-1123 label")
+    if errors:
+        raise ValidationError(errors)
 
 
 def validation_warnings(job: TPUJob) -> List[str]:

@@ -119,6 +119,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gang-preemption", action="store_true",
                    help="let higher-priority groups evict admitted-but-"
                         "not-yet-running lower-priority groups")
+    p.add_argument("--enable-tenant-queues", action="store_true",
+                   help="run multi-tenant quota admission above gang "
+                        "scheduling (requires --enable-gang-scheduling): "
+                        "jobs reference a TenantQueue via spec.queueName; "
+                        "ClusterQueues carry nominal chip quotas, cohort "
+                        "borrowing, and reclaim (docs/quota.md). Off = "
+                        "admission behavior identical to today")
+    p.add_argument("--queue-config", default=None,
+                   help="YAML/JSON file declaring clusterQueues / "
+                        "tenantQueues to seed at startup (see "
+                        "docs/quota.md for the format); queues can also "
+                        "be created live through the served API")
     p.add_argument("--gang-binder", default=True,
                    action=argparse.BooleanOptionalAction,
                    help="(kube backend) run the in-operator slice-gang "
@@ -231,6 +243,10 @@ class Server:
             gang_queue_quotas=parse_int_map(
                 getattr(args, "gang_queue_quotas", "")),
             gang_preemption=getattr(args, "gang_preemption", False))
+        tenant_kwargs = dict(
+            enable_tenant_queues=getattr(args, "enable_tenant_queues",
+                                         False),
+            queue_config=getattr(args, "queue_config", None))
         if getattr(args, "backend", "local") == "kube":
             # Cluster mode: the Store is the informer cache inside
             # KubeOperator; reads/writes/leases go to the K8s API.
@@ -270,7 +286,7 @@ class Server:
             self.operator = Operator(
                 store=self.store,
                 namespace=args.namespace or None,
-                **gang_kwargs, **op_kwargs)
+                **gang_kwargs, **tenant_kwargs, **op_kwargs)
         self.api_server = None
         if getattr(args, "api_port", 0) != 0:
             from tf_operator_tpu.runtime.apiserver import APIServer
@@ -410,6 +426,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.error("--backend none needs --api-port: without a served "
                      "API no node agent can reach the control plane, so "
                      "pods would sit Pending forever")
+    if args.enable_tenant_queues and not args.enable_gang_scheduling:
+        parser.error("--enable-tenant-queues requires "
+                     "--enable-gang-scheduling: tenant queues decide "
+                     "WHICH gangs are quota-eligible; without gang "
+                     "admission there is nothing to gate")
+    if args.enable_tenant_queues and args.backend == "kube":
+        parser.error("--enable-tenant-queues is not yet supported with "
+                     "--backend kube (the TenantQueue/ClusterQueue kinds "
+                     "have no CRD/informer mirror yet); use the local or "
+                     "served backend")
+    if args.queue_config and not args.enable_tenant_queues:
+        parser.error("--queue-config only makes sense with "
+                     "--enable-tenant-queues")
     if args.backend == "kube" and args.api_port != 0:
         parser.error("--backend kube cannot serve --api-port: the Store "
                      "is a read cache of the cluster there, so jobs "

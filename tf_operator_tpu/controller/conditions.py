@@ -30,6 +30,10 @@ JOB_SUCCEEDED_REASON = "JobSucceeded"
 JOB_RUNNING_REASON = "JobRunning"
 JOB_FAILED_REASON = "JobFailed"
 JOB_RESTARTING_REASON = "JobRestarting"
+# TPU extensions (controller/quota.py): tenant-queue admission arc.
+JOB_QUEUED_REASON = "QueuedWaitingForQuota"
+JOB_QUOTA_ADMITTED_REASON = "QuotaAdmitted"
+JOB_QUOTA_EXCEEDED_REASON = "QuotaExceeded"
 
 
 def _now() -> _dt.datetime:
@@ -72,6 +76,22 @@ def update_job_conditions(status: JobStatus, cond_type: str, reason: str,
                              last_update_time=_now(),
                              last_transition_time=_now())
     _set_condition(status, condition)
+
+
+def mark_condition_false(status: JobStatus, cond_type: str, reason: str,
+                         message: str) -> None:
+    """Flip an existing True condition to False (no reference analog:
+    the reference never resolves a condition, it only supersedes; the
+    Queued tenant-quota condition resolves on admission and must say
+    so rather than linger True). No-op when the condition is absent or
+    already False — level-triggered callers can re-assert freely."""
+    current = get_condition(status, cond_type)
+    if current is None or current.status == ConditionStatus.FALSE:
+        return
+    _set_condition(status, JobCondition(
+        type=cond_type, status=ConditionStatus.FALSE, reason=reason,
+        message=message, last_update_time=_now(),
+        last_transition_time=_now()))
 
 
 def _set_condition(status: JobStatus, condition: JobCondition) -> None:

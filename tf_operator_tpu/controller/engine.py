@@ -151,6 +151,14 @@ class GangScheduler(abc.ABC):
         without a health subsystem report None."""
         return None
 
+    def quota_status(self, job: TPUJob):
+        """Non-None (controller/quota.py QuotaWait) while the job's
+        gang is held by tenant-queue quota; the engine rolls it into
+        the job's Queued condition — or fails the job terminally when
+        the wait can never end (zero-quota queue). Schedulers without
+        a quota subsystem report None."""
+        return None
+
 
 @dataclass
 class EngineConfig:
@@ -248,6 +256,44 @@ class JobEngine:
         # General path.
         if self.config.enable_gang_scheduling and self.gang:
             self.gang.sync_slice_group(job, replica_specs)
+            # Tenant-queue quota arc (controller/quota.py): while the
+            # gang is quota-held, the job carries a Queued condition;
+            # on admission it resolves to False; a wait that can never
+            # end (zero-quota queue) fails the job terminally, exactly
+            # like the backoff/deadline path above.
+            quota_wait = self.gang.quota_status(job)
+            if quota_wait is not None and quota_wait.terminal:
+                msg = (f"TPUJob {job.metadata.name} has failed because "
+                       f"its queue can never admit it: "
+                       f"{quota_wait.message}")
+                if job.status.completion_time is None:
+                    job.status.completion_time = _now()
+                self._delete_pods_and_endpoints(job, pods)
+                self._cleanup_job_if_ttl(job)
+                self.recorder.event(job, EVENT_TYPE_NORMAL,
+                                    JOB_TERMINATED_REASON,
+                                    "Job has been terminated. "
+                                    "Deleting SliceGroup")
+                self.gang.delete_slice_group(job)
+                self.recorder.event(job, EVENT_TYPE_WARNING,
+                                    cond.JOB_QUOTA_EXCEEDED_REASON, msg)
+                cond.update_job_conditions(
+                    job.status, JobConditionType.FAILED,
+                    cond.JOB_QUOTA_EXCEEDED_REASON, msg)
+                self.plugin.update_job_status_in_api(job)
+                return
+            if quota_wait is not None:
+                cond.update_job_conditions(
+                    job.status, JobConditionType.QUEUED,
+                    cond.JOB_QUEUED_REASON,
+                    f"TPUJob {job.metadata.name} is queued: "
+                    f"{quota_wait.message}")
+            else:
+                cond.mark_condition_false(
+                    job.status, JobConditionType.QUEUED,
+                    cond.JOB_QUOTA_ADMITTED_REASON,
+                    f"TPUJob {job.metadata.name} was admitted by its "
+                    "queue")
             # Slice-health drain in progress: surface restart-with-
             # identity on the job — Restarting until the gang is fully
             # back up, then the status machine flips it to Running (the

@@ -130,7 +130,8 @@ class SliceGangScheduler(GangScheduler):
                  scheduled_pods_occupy: bool = False,
                  capacity_provider=None,
                  domain_capacity_provider=None,
-                 draining_provider=None):
+                 draining_provider=None,
+                 quota=None):
         if fairness not in ("backfill", "strict", "aged"):
             raise ValueError(f"unknown gang fairness {fairness!r}")
         self.store = store
@@ -155,6 +156,14 @@ class SliceGangScheduler(GangScheduler):
         # the same window on the kube backend, where
         # scheduled_pods_occupy + the pod object's lifetime covers it).
         self.draining_provider = draining_provider
+        # Optional multi-tenant quota hook (controller/quota.py
+        # TenantQueueManager): consulted per pending group each
+        # admission pass — it decides quota ELIGIBILITY (nominal /
+        # borrow / reclaim), this scheduler keeps deciding physical
+        # fit. None = pre-quota behavior, byte-identical.
+        self.quota = quota
+        if quota is not None and getattr(quota, "priority_of", None):
+            quota.priority_of = self._priority_of
         self.fairness = fairness
         self.aging_seconds = aging_seconds
         self.priority_classes = dict(priority_classes or {})
@@ -200,6 +209,11 @@ class SliceGangScheduler(GangScheduler):
                 min_member = sp.min_available
             queue = sp.queue
             priority = sp.priority_class
+        # Tenant-queue membership (controller/quota.py): spec.queueName
+        # is authoritative when set — the group admits through that
+        # TenantQueue's quota AND uses it as its fairness lane.
+        if job.spec.queue_name:
+            queue = job.spec.queue_name
 
         desired_spec = SliceGroupSpec(min_member=min_member, queue=queue,
                                       priority_class=priority,
@@ -321,6 +335,14 @@ class SliceGangScheduler(GangScheduler):
         only trigger, stalling admission until the next resync)."""
         self._admit()
 
+    def quota_status(self, job: TPUJob):
+        """Engine hook (controller/quota.py QuotaWait | None): why the
+        job's gang is held by tenant-queue quota — rolled into the
+        job's Queued condition, or a terminal QuotaExceeded failure."""
+        if self.quota is None:
+            return None
+        return self.quota.status_for(job)
+
     def delete_slice_group(self, job: TPUJob) -> None:
         if self.pdb_control is not None:
             self.pdb_control.delete(job)
@@ -410,6 +432,17 @@ class SliceGangScheduler(GangScheduler):
                                g.metadata.name))
             live_keys = {(g.metadata.namespace, g.metadata.name)
                          for g in groups}
+            # Tenant-queue quota ledger for THIS pass (None = quota
+            # off). It answers eligibility per pending group; failures
+            # degrade to quota-off admission rather than stalling the
+            # fleet.
+            qpass = None
+            if self.quota is not None:
+                try:
+                    qpass = self.quota.plan(groups, _chips_for, now)
+                except Exception:
+                    log.exception("tenant-queue quota plan failed; "
+                                  "running this pass without quota")
             used = 0
             queue_used: Dict[str, int] = {}
             # Groups not admissible this pass because their pods still
@@ -420,9 +453,17 @@ class SliceGangScheduler(GangScheduler):
             # failed deletes retry on every pass with no extra state.
             evicting = set()
             # One pod-store scan per pass; mid-eviction state can only
-            # exist when preemption is on (nothing else flips a group
-            # with released pods back to Pending).
-            occ_index = self._occupancy_index() if self.preemption else {}
+            # exist when something flips a group with released pods
+            # back to Pending: priority preemption, or a tenant-queue
+            # quota reclaim (displace leaves the victim's pods to this
+            # level-triggered eviction path, exactly like preemption —
+            # chips stay counted until the deletes land, so a nominal
+            # demander is never admitted into the borrower's dying
+            # window). Slice-health drains evict their pods themselves
+            # before displacing (controller/health.py _drain).
+            occ_index = (self._occupancy_index()
+                         if self.preemption or self.quota is not None
+                         else {})
             for g in groups:
                 gk = (g.metadata.namespace, g.metadata.name)
                 occupied = g.status.phase in (PHASE_INQUEUE, PHASE_RUNNING)
@@ -450,6 +491,14 @@ class SliceGangScheduler(GangScheduler):
             # Per-queue lane blocking: queue -> minimum priority still
             # allowed to backfill (None = hard block, nothing admits).
             blocked: Dict[str, Optional[int]] = {}
+            # queue -> True while EVERY blocker of that lane was held by
+            # quota alone (chips were free). Such a lane lets quota-
+            # clean under-nominal groups through: the head is waiting
+            # on quota that may itself be waiting on another queue's
+            # nominal demand admitting THROUGH this lane — holding them
+            # back deadlocks the cohort (pinned by
+            # hack/verify-quota-invariants.py).
+            lane_quota_only: Dict[str, bool] = {}
             # Chips held back for aged-out groups. Their lane block alone
             # can't protect them: the chip budget is global, so backfill
             # from *other* queues would otherwise keep consuming freed
@@ -498,17 +547,38 @@ class SliceGangScheduler(GangScheduler):
                     continue
                 if q in blocked:
                     floor = blocked[q]
-                    if floor is None or pri < floor:
+                    passes_quota_lane = False
+                    if lane_quota_only.get(q) and qpass is not None:
+                        # Quota-held lane: an under-nominal (borrow-free)
+                        # group may pass the waiting head — its claim is
+                        # on its own queue's share.
+                        bp_ok, bp_borrow, _, _ = qpass.evaluate(group,
+                                                                need)
+                        passes_quota_lane = bp_ok and bp_borrow == 0
+                    if not passes_quota_lane and (floor is None
+                                                  or pri < floor):
                         continue  # lane held for an earlier group
-                fits = ((self._cap is None
-                         or used + reserved + need <= self._cap)
-                        and (quota is None
-                             or queue_used.get(q, 0) + need <= quota))
-                if not fits and self.preemption:
+                fits_phys = ((self._cap is None
+                              or used + reserved + need <= self._cap)
+                             and (quota is None
+                                  or queue_used.get(q, 0) + need <= quota))
+                # Quota eligibility (tenant queues): evaluated even when
+                # physically blocked so reclaim demands register.
+                q_ok, q_borrow, q_why, q_terminal = True, 0, None, False
+                if qpass is not None:
+                    q_ok, q_borrow, q_why, q_terminal = qpass.evaluate(
+                        group, need)
+                fits = fits_phys and q_ok
+                if not fits and self.preemption and q_ok and not fits_phys:
+                    # Priority preemption frees PHYSICAL capacity only —
+                    # never fired to solve a quota block (that's the
+                    # quota manager's reclaim path).
                     fits, used, queue_used, ev_pending = self._try_preempt(
                         groups, group, need, pri, q, quota,
                         used, queue_used, reserved, now,
                         evicting, to_evict, occ_index)
+                    if fits:
+                        fits_phys = True
                     if not fits and ev_pending:
                         # Chips are inbound for THIS group (victims died
                         # or are dying for it). Earmark them — lane block
@@ -519,16 +589,30 @@ class SliceGangScheduler(GangScheduler):
                         # deletes are confirmed.
                         reserved += need
                         blocked[q] = None
+                        lane_quota_only[q] = False
                         continue
                 if not fits:
+                    if qpass is not None:
+                        qpass.on_blocked(group, need, q_ok, q_why,
+                                         q_terminal, fits_phys, pri)
+                        if q_terminal:
+                            # Never admissible through its queue (e.g.
+                            # zero-quota): like the infeasible skip, it
+                            # must not hold the lane or book budget —
+                            # the engine fails the job off the recorded
+                            # wait state.
+                            continue
                     if self.fairness == "backfill":
                         continue  # pure skip: later groups may still fit
+                    quota_only = fits_phys and not q_ok
+                    lane_quota_only[q] = (lane_quota_only.get(q, True)
+                                          and quota_only)
                     since = self._pending_since(group)
                     waited = ((now - since).total_seconds()
                               if since is not None else 0.0)
                     if (self.fairness == "strict"
                             or waited >= self.aging_seconds):
-                        if self.fairness == "aged":
+                        if self.fairness == "aged" and not fits_phys:
                             log.info("slice group %s aged out backfill; "
                                      "reserving %d chips for it",
                                      group.metadata.name, need)
@@ -536,6 +620,8 @@ class SliceGangScheduler(GangScheduler):
                             # cross-queue backfill can't eat freed
                             # capacity (strict mode stays per-queue by
                             # design: lane isolation is its contract).
+                            # Quota-only blocks reserve nothing: chips
+                            # aren't the scarce thing, quota is.
                             reserved += need
                         blocked[q] = None  # hard block: lane waits
                     else:
@@ -550,9 +636,19 @@ class SliceGangScheduler(GangScheduler):
                 queue_used[q] = queue_used.get(q, 0) + need
                 group.status.phase = PHASE_INQUEUE
                 self.store.update_status(store_mod.SLICEGROUPS, group)
+                if qpass is not None:
+                    qpass.on_admit(group, need, q_borrow)
                 log.info("admitted slice group %s (%d chips, queue=%r, "
                          "priority=%d)", group.metadata.name, need, q, pri)
             self._warned_infeasible &= live_keys
+            # Quota reclaim plan + per-queue status/metrics publication.
+            reclaims: List[tuple] = []
+            if qpass is not None:
+                try:
+                    reclaims = qpass.reclaims()
+                    qpass.finish()
+                except Exception:
+                    log.exception("tenant-queue quota pass finish failed")
         # Pod deletes are API I/O on the kube backend — never under the
         # lock. Completed evictions free their chips on the next pass
         # (triggered by the pods' DELETED events re-enqueuing jobs);
@@ -565,6 +661,17 @@ class SliceGangScheduler(GangScheduler):
         # test_preemptor_spawns_only_after_victim_exits).
         for ns, name in to_evict:
             self._evict_pods(ns, name)
+        # Quota reclaim displacements: borrowed gangs go back through
+        # admission (the slice-health re-admission path — original
+        # priority, fresh aging window, level-triggered pod eviction)
+        # so a cohort member can take its nominal share back. Outside
+        # the lock: displace re-enters _admit.
+        for ns, name, qname, reason in reclaims:
+            if self.displace(ns, name, reason) and self.quota is not None:
+                try:
+                    self.quota.note_reclaimed(qname, ns, name, reason)
+                except Exception:
+                    log.debug("quota reclaim note failed", exc_info=True)
 
     def _try_preempt(self, groups: List[SliceGroup], group: SliceGroup,
                      need: int, pri: int, q: str, quota: Optional[int],

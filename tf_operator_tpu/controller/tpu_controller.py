@@ -165,6 +165,34 @@ class TPUJobController(JobPlugin):
             self.store.watch(store_mod.PODS, self._on_pod_event),
             self.store.watch(store_mod.ENDPOINTS, self._on_endpoint_event),
         ]
+        if getattr(self.engine.gang, "quota", None) is not None:
+            # Tenant-queue admission is live-configured: queue writes
+            # must re-drive admission and job conditions, not wait for
+            # the resync period.
+            self._watchers += [
+                self.store.watch(store_mod.TENANTQUEUES,
+                                 self._on_queue_event),
+                self.store.watch(store_mod.CLUSTERQUEUES,
+                                 self._on_queue_event),
+            ]
+
+    def _on_queue_event(self, event_type: str, obj) -> None:
+        """Quota topology changed (TenantQueue/ClusterQueue created,
+        edited, or deleted): re-run admission — freed or granted quota
+        may admit waiting groups; a deleted TenantQueue re-queues its
+        pending groups to the default queue (controller/quota.py emits
+        the QueueDeleted event) — then re-enqueue every watched job so
+        Queued conditions track the new config."""
+        gang = self.engine.gang
+        if gang is not None and hasattr(gang, "readmit"):
+            try:
+                gang.readmit()
+            except Exception:
+                log.exception("re-admission after queue event failed")
+        for key in self.store.project(store_mod.TPUJOBS,
+                                      lambda j: j.key(),
+                                      namespace=self.namespace):
+            self.enqueue(key)
 
     def _on_job_event(self, event_type: str, job: TPUJob) -> None:
         if self.namespace and job.metadata.namespace != self.namespace:

@@ -44,19 +44,38 @@ class Operator:
                  gang_aging_seconds: float = 300.0,
                  gang_priority_classes: Optional[dict] = None,
                  gang_queue_quotas: Optional[dict] = None,
-                 gang_preemption: bool = False):
+                 gang_preemption: bool = False,
+                 enable_tenant_queues: bool = False,
+                 queue_config: Optional[str] = None):
         self.store = store or Store()
         self.recorder = Recorder(sink=self._persist_event)
         config = config or EngineConfig()
         gang = None
+        self.quota = None
+        if enable_tenant_queues and not enable_gang_scheduling:
+            raise ValueError("tenant queues sit above gang admission: "
+                             "--enable-tenant-queues requires "
+                             "--enable-gang-scheduling")
         if enable_gang_scheduling:
             config.enable_gang_scheduling = True
+            if enable_tenant_queues:
+                from tf_operator_tpu.controller.quota import (
+                    TenantQueueManager,
+                    load_queue_config,
+                    seed_queues,
+                )
+
+                self.quota = TenantQueueManager(self.store,
+                                                recorder=self.recorder)
+                if queue_config:
+                    seed_queues(self.store, *load_queue_config(queue_config))
             gang = SliceGangScheduler(self.store, total_chips=total_chips,
                                       fairness=gang_fairness,
                                       aging_seconds=gang_aging_seconds,
                                       priority_classes=gang_priority_classes,
                                       queue_quotas=gang_queue_quotas,
-                                      preemption=gang_preemption)
+                                      preemption=gang_preemption,
+                                      quota=self.quota)
         self.controller = TPUJobController(self.store, recorder=self.recorder,
                                            config=config, gang=gang,
                                            namespace=namespace)

@@ -59,11 +59,13 @@ from typing import Dict, Optional, Type
 
 from tf_operator_tpu.api.serde import ApiObject
 from tf_operator_tpu.api.types import (
+    ClusterQueue,
     Endpoint,
     EventRecord,
     Node,
     Pod,
     SliceGroup,
+    TenantQueue,
     TPUJob,
 )
 from tf_operator_tpu.runtime import leaderelection, store as store_mod
@@ -79,6 +81,8 @@ WIRE_KINDS: Dict[str, Type[ApiObject]] = {
     store_mod.PODS: Pod,
     store_mod.ENDPOINTS: Endpoint,
     store_mod.SLICEGROUPS: SliceGroup,
+    store_mod.TENANTQUEUES: TenantQueue,
+    store_mod.CLUSTERQUEUES: ClusterQueue,
     store_mod.EVENTS: EventRecord,
     store_mod.NODES: Node,
     leaderelection.LEASES: leaderelection.Lease,

@@ -40,6 +40,14 @@ REASON_SLICE_DRAIN_PENDING = "SliceDrainPending"
 REASON_SLICE_DRAINED = "SliceDrained"
 REASON_SLICE_REBOUND = "SliceRebound"
 
+# Tenant-queue quota event reasons (controller/quota.py) — the
+# quota-admission lifecycle's observable edges.
+REASON_QUEUED_WAITING_FOR_QUOTA = "QueuedWaitingForQuota"
+REASON_QUOTA_EXCEEDED = "QuotaExceeded"
+REASON_BORROWED_CAPACITY = "BorrowedCapacity"
+REASON_QUOTA_RECLAIMED = "QuotaReclaimed"
+REASON_QUEUE_DELETED = "QueueDeleted"
+
 
 @dataclass
 class Event:

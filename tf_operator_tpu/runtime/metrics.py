@@ -315,3 +315,24 @@ events_aggregated = REGISTRY.counter(
     "Recorder events folded into an existing event (duplicate count "
     "bump or EventAggregator-style similar-event collapse) instead of "
     "stored/posted individually")
+queue_pending_slices = REGISTRY.gauge(
+    "tpu_operator_queue_pending_slices",
+    "SliceGroups of a tenant queue waiting for quota or capacity",
+    ["queue"])
+queue_admitted_chips = REGISTRY.gauge(
+    "tpu_operator_queue_admitted_chips",
+    "Chips currently admitted through a ClusterQueue", ["queue"])
+queue_borrowed_chips = REGISTRY.gauge(
+    "tpu_operator_queue_borrowed_chips",
+    "Portion of a ClusterQueue's admitted chips above its nominal quota "
+    "(borrowed from idle cohort capacity)", ["queue"])
+quota_reclaims = REGISTRY.counter(
+    "tpu_operator_quota_reclaims_total",
+    "Borrowed gangs displaced back to Pending so a cohort member could "
+    "take its nominal quota back", ["queue"])
+queue_admission_wait_seconds = REGISTRY.histogram(
+    "tpu_operator_queue_admission_wait_seconds",
+    "Pending to quota-admitted wait of gang SliceGroups, per tenant "
+    "queue", ["queue"],
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+             300.0, 600.0, 1800.0))

@@ -358,3 +358,8 @@ ENDPOINTS = "endpoints"
 SLICEGROUPS = "slicegroups"
 EVENTS = "events"
 NODES = "nodes"
+# Multi-tenant admission (controller/quota.py). TENANTQUEUES is
+# namespaced; CLUSTERQUEUES is cluster-scoped (stored under the
+# reserved namespace "").
+TENANTQUEUES = "tenantqueues"
+CLUSTERQUEUES = "clusterqueues"

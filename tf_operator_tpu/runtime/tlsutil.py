@@ -41,10 +41,22 @@ def ensure_self_signed(cert_path: str, key_path: str,
     the operator's service DNS name / host IPs for remote clients."""
     if os.path.exists(cert_path) and os.path.exists(key_path):
         return
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import rsa
-    from cryptography.x509.oid import NameOID
+    try:
+        # Imported lazily: cryptography is an optional extra
+        # (``pip install tf-operator-tpu[tls]``) — the operator's
+        # token-auth path and every non-TLS deployment must work
+        # without it, and only actual cert GENERATION needs it
+        # (pre-provisioned cert/key pairs are served by the stdlib).
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+    except ImportError as e:
+        raise RuntimeError(
+            "self-signed TLS bootstrap needs the 'cryptography' package; "
+            "install the tls extra (pip install tf-operator-tpu[tls]) or "
+            "provide --api-tls-cert/--api-tls-key generated elsewhere"
+        ) from e
 
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
