@@ -1,15 +1,22 @@
 """Mixtral-style MoE training throughput (round 5 — the last model
-family without a measured number).
+family without a measured number; round 6 adds ``--dispatch``).
 
 A mid-size MoE decoder (8 experts, top-2, GShard capacity dispatch) on
 one chip: ep=1 collapses the all-to-alls, but the dispatch/combine
-einsums, router, capacity dropping, and aux loss all run exactly as in
+machinery, router, capacity dropping, and aux loss all run exactly as in
 the sharded path, so this prices the MoE machinery itself. Model MFU
 counts ACTIVE parameters only (attention + top-k of the expert stack)
 — the MoE selling point is exactly that inactive experts cost no
 FLOPs, so counting them would flatter the number.
 
+``--dispatch`` selects the routing implementation (numerics-equivalent;
+tests/test_moe_dispatch.py): ``einsum`` = one-hot [T,E,C] dispatch/
+combine einsums (the GShard formulation, round-5 headline), ``gather``
+= argsort + gather/scatter token permutation (round-6 fast path; see
+docs/benchmarks.md MoE roofline for the byte/FLOP budget).
+
     python benchmarks/bench_moe.py [--batch 8] [--seq 2048]
+        [--dispatch einsum|gather]
 """
 
 from __future__ import annotations
@@ -24,16 +31,11 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import timing  # noqa: E402
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--batch", type=int, default=8)
-    ap.add_argument("--seq", type=int, default=2048)
-    ap.add_argument("--steps", type=int, default=8)
-    ap.add_argument("--peak-tflops", type=float, default=197.0)
-    ap.add_argument("--preset", default="512m", choices=["512m", "tiny"],
-                    help="tiny = CPU-smoke-sized model")
-    args = ap.parse_args()
-
+def build_moe_step(preset: str, batch: int, seq: int,
+                   dispatch: str = "einsum"):
+    """The exact benchmarked MoE program: (step, state, batch_d, cfg,
+    mesh_ctx). Shared with benchmarks/profile_moe.py so the profile is
+    of this step, not a re-implementation that could drift."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -49,18 +51,17 @@ def main() -> int:
     from tf_operator_tpu.parallel.sharding import MOE_RULES
     from tf_operator_tpu.train.trainer import Trainer
 
-    if args.preset == "tiny":
+    if preset == "tiny":
         cfg = MixtralConfig(vocab_size=512, hidden=128, n_layers=2,
                             n_heads=4, n_kv_heads=2, head_dim=32,
                             mlp_dim=256, n_experts=4, experts_per_token=2,
-                            max_seq_len=args.seq, remat=False,
-                            rope_theta=10000.0)
+                            max_seq_len=seq, remat=False,
+                            rope_theta=10000.0, dispatch=dispatch)
     else:
         cfg = MixtralConfig(vocab_size=32768, hidden=1024, n_layers=8,
                             n_heads=16, n_kv_heads=4, head_dim=128,
                             mlp_dim=2048, n_experts=8, experts_per_token=2,
-                            max_seq_len=args.seq, remat=True)
-    B, S = args.batch, args.seq
+                            max_seq_len=seq, remat=True, dispatch=dispatch)
     mesh = make_mesh(MeshConfig(dp=-1))
     # make_moe_lm_loss attaches its own model_inputs_fn; Trainer
     # auto-detects it.
@@ -69,37 +70,82 @@ def main() -> int:
                       optimizer=optax.adamw(1e-4),
                       loss_fn=make_moe_lm_loss(cfg.aux_loss_weight))
     rng = jax.random.PRNGKey(0)
-    sample = {"inputs": jnp.zeros((B, S + 1), jnp.int32)}
-    with use_mesh(mesh):
-        state, sh = trainer.init(rng, sample)
-        step = trainer.make_train_step(sh, sample)
-        tok = jnp.asarray(np.random.default_rng(0).integers(
-            0, cfg.vocab_size, (B, S + 1)), jnp.int32)
-        for _ in range(3):
-            state, m = step(state, {"inputs": tok})
-        float(m["loss"])  # host sync (block_until_ready lies on axon)
-        dt, dt_single, state = timing.timed_two_block_stateful(
-            step, state, {"inputs": tok}, args.steps)
+    sample = {"inputs": jnp.zeros((batch, seq + 1), jnp.int32)}
+    ctx = use_mesh(mesh)
+    ctx.__enter__()
+    state, sh = trainer.init(rng, sample)
+    step = trainer.make_train_step(sh, sample)
+    tok = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, seq + 1)), jnp.int32)
+    return step, state, {"inputs": tok}, cfg, ctx
 
-    nparams = sum(x.size for x in jax.tree.leaves(state.params))
-    # Active params: experts contribute k/E of their weights per token.
+
+def active_param_count(cfg, nparams: int) -> int:
+    """Active params: experts contribute k/E of their weights per token."""
     expert_params = 3 * cfg.hidden * cfg.mlp_dim * cfg.n_experts \
         * cfg.n_layers
-    active = nparams - expert_params * (
-        1 - cfg.experts_per_token / cfg.n_experts)
-    attn_fl = 3.5 * 4 * cfg.n_layers * cfg.n_heads * S * S \
-        * cfg.head_dim / 2 * B
-    flops = 6 * active * B * S + attn_fl
+    return int(nparams - expert_params * (
+        1 - cfg.experts_per_token / cfg.n_experts))
+
+
+def moe_step_flops(cfg, nparams: int, batch: int, seq: int) -> float:
+    """Model FLOPs/step credited by the MFU metric: 6·active·tokens +
+    causal attention (same formula the dense Llama bench uses)."""
+    active = active_param_count(cfg, nparams)
+    attn_fl = 3.5 * 4 * cfg.n_layers * cfg.n_heads * seq * seq \
+        * cfg.head_dim / 2 * batch
+    return 6 * active * batch * seq + attn_fl
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    ap.add_argument("--preset", default="512m", choices=["512m", "tiny"],
+                    help="tiny = CPU-smoke-sized model")
+    ap.add_argument("--dispatch", default="einsum",
+                    choices=["einsum", "gather"],
+                    help="MoE routing implementation (MixtralConfig."
+                         "dispatch); numerics-equivalent")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from bench import bench_config_fingerprint, bench_environment, detect_chip
+
+    step, state, batch_d, cfg, ctx = build_moe_step(
+        args.preset, args.batch, args.seq, args.dispatch)
+    B, S = args.batch, args.seq
+    for _ in range(3):
+        state, m = step(state, batch_d)
+    float(m["loss"])  # host sync (block_until_ready lies on axon)
+    dt, dt_single, state = timing.timed_two_block_stateful(
+        step, state, batch_d, args.steps)
+    ctx.__exit__(None, None, None)
+
+    nparams = sum(x.size for x in jax.tree.leaves(state.params))
+    active = active_param_count(cfg, nparams)
+    flops = moe_step_flops(cfg, nparams, B, S)
+    config = {"preset": args.preset, "batch": B, "seq": S,
+              "steps": args.steps, "dispatch": args.dispatch,
+              "capacity_factor": cfg.capacity_factor,
+              "n_experts": cfg.n_experts,
+              "experts_per_token": cfg.experts_per_token}
     print(json.dumps({
         "what": f"mixtral{nparams // 1_000_000}m_moe_train[top"
                 f"{cfg.experts_per_token}of{cfg.n_experts}]",
+        "dispatch": args.dispatch,
         "ms_per_step": round(dt * 1e3, 1),
         "ms_per_step_single_block": round(dt_single * 1e3, 1),
         "tokens_per_sec": round(B * S / dt),
         "params_total": nparams,
-        "params_active": int(active),
+        "params_active": active,
         "model_mfu_active": round(flops / dt / (args.peak_tflops * 1e12),
                                   3),
+        "env": bench_environment(detect_chip()),
+        "config_fingerprint": bench_config_fingerprint(config),
     }))
     return 0
 

@@ -1,14 +1,22 @@
-"""Parameter-server throughput envelope (round-5 verdict #6).
+"""Parameter-server throughput envelope (round-5 verdict #6; round 6
+adds the multi-shard scaling row).
 
 Round 4 shipped the PS runtime functional but unquantified. This bench
 measures the full worker step cycle — pull all params, push all
 gradients — against in-process sharded servers over loopback HTTP
-(the same stdlib wire path production uses), sweeping parameter size
-and worker count, and reports the sequential-vs-concurrent shard
-fan-out comparison that motivated PSClient's thread-per-shard IO.
+(the same stdlib wire path production uses), sweeping parameter size,
+worker count, AND shard count, and reports the sequential-vs-concurrent
+shard fan-out comparison that motivated PSClient's thread-per-shard IO.
+
+``--shards`` takes a comma list: the multi-shard rows at a fixed total
+parameter size (e.g. 4 shards × ~12.5 MB vs 1 shard × 50 MB) measure
+the documented "scale shard count, not workers per shard" remedy —
+each shard applies pushes under its own lock in its own server, so
+shard count is the axis that recovers steps/s for bigger models
+(docs/benchmarks.md "Parameter-server envelope").
 
     python benchmarks/bench_ps.py [--sizes-mb 1,10,50] [--workers 1,4]
-        [--shards 2]
+        [--shards 1,4]
 
 Emits a JSON table; docs/benchmarks.md carries the measured envelope.
 """
@@ -104,16 +112,19 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--sizes-mb", default="1,10,50")
     ap.add_argument("--workers", default="1,4")
-    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--shards", default="2",
+                    help="comma list; same TOTAL size spreads over more "
+                         "shards (the scale-shard-count remedy)")
     ap.add_argument("--seconds", type=float, default=3.0)
     args = ap.parse_args()
     rows = []
     for size in (float(s) for s in args.sizes_mb.split(",")):
-        for nw in (int(w) for w in args.workers.split(",")):
-            for conc in (False, True):
-                row = run_case(size, nw, args.shards, args.seconds, conc)
-                rows.append(row)
-                print(json.dumps(row), flush=True)
+        for ns in (int(s) for s in args.shards.split(",")):
+            for nw in (int(w) for w in args.workers.split(",")):
+                for conc in (False, True):
+                    row = run_case(size, nw, ns, args.seconds, conc)
+                    rows.append(row)
+                    print(json.dumps(row), flush=True)
     return 0
 
 

@@ -1,0 +1,229 @@
+"""Full-step XLA profile of the Mixtral MoE training step (round-6
+roofline; VERDICT round-5 "Next round" #1).
+
+Round 5 measured the 512M MoE at 19,850 tok/s = 15.1% active-param MFU
+against the dense decoder's 47% and *explained* the gap in prose
+(dispatch einsums, capacity factor, router) without profiling it. This
+tool captures the exact ``bench_moe`` training step under
+``jax.profiler.trace`` (same methodology as ``profile_llama.py``) and
+aggregates:
+
+- the generic per-HLO-category step budget (``profile_step.parse_trace``);
+- an MoE bucket table — expert FFN einsums, dispatch/combine routing,
+  router/top-k/aux, optimizer+elementwise, attention — classified from
+  fusion operand shapes (best-effort; the residual is reported as
+  ``unattributed``, never silently spread);
+- the *analytic* dispatch budget for the profiled config: one-hot
+  dispatch/combine einsum FLOPs, routing-tensor bytes, and expert-FFN
+  FLOPs per step, computed exactly from the shapes — the structural
+  part of the roofline that holds whatever the fusion boundaries do.
+
+``--dispatch gather`` profiles the sort/gather routing path for the
+A/B. On a host without the chip the trace carries op times but no
+bytes/FLOP counters (CPU fallback in ``parse_trace``); the artifact
+schema is identical so tier-1 smoke-pins it (tests/test_bench_moe.py).
+
+Usage:
+    python benchmarks/profile_moe.py [--steps 4] [--dispatch einsum]
+        [--preset 512m|tiny] [--out results.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_moe import (  # noqa: E402
+    active_param_count,
+    build_moe_step,
+    moe_step_flops,
+)
+from profile_step import parse_trace  # noqa: E402  (stdlib-only parser)
+
+MOE_BUCKETS = ("expert_ffn", "dispatch_combine", "router_topk_aux",
+               "attention", "optimizer_elementwise", "unattributed")
+
+
+def _capacity(cfg, batch: int, seq: int) -> int:
+    t = batch * seq
+    return max(cfg.experts_per_token,
+               int(t * cfg.experts_per_token * cfg.capacity_factor
+                   / cfg.n_experts))
+
+
+def analytic_dispatch_budget(cfg, batch: int, seq: int,
+                             nparams: int) -> dict:
+    """Exact per-step byte/FLOP budget of the routing machinery — the
+    structural half of the roofline, independent of fusion boundaries.
+
+    einsum path: dispatch ("tec,th->ech") and combine ("tec,ech->th")
+    each execute 2·T·E·C·H FLOPs forward; backward re-runs the combine
+    contraction twice (d_combine and d_expert_out) and the dispatch
+    contraction once (d_x; the one-hot dispatch tensor itself is
+    integer-derived, no cotangent), so 5 such contractions per layer
+    per step before remat. The [T,E,C] routing tensors cost
+    2·T·E·C·itemsize bytes per layer to materialize.
+
+    gather path: the same permutation moves only 2·(E·C·H + T·K·H)
+    buffer bytes per direction and O(T·K·log T·K) sort keys — FLOPs ~0.
+    """
+    t = batch * seq
+    e, k, h = cfg.n_experts, cfg.experts_per_token, cfg.hidden
+    c = _capacity(cfg, batch, seq)
+    m = cfg.mlp_dim
+    item = 2 if cfg.dtype.__name__ == "bfloat16" else 4
+    contraction = 2.0 * t * e * c * h                 # one tec-einsum
+    ffn_fwd = 3 * 2.0 * e * c * h * m                 # gate/up/down
+    layers = cfg.n_layers
+    return {
+        "capacity": c,
+        "dispatch_einsum_tflop_per_step_fwd": round(
+            2 * contraction * layers / 1e12, 2),
+        "dispatch_einsum_tflop_per_step_fwd_bwd": round(
+            5 * contraction * layers / 1e12, 2),
+        "routing_tensor_gb_per_layer": round(2 * t * e * c * item / 1e9, 2),
+        "expert_ffn_tflop_per_step_fwd": round(ffn_fwd * layers / 1e12, 2),
+        "gather_buffer_gb_per_layer": round(
+            2 * (e * c * h + t * k * h) * item / 1e9, 3),
+        "model_tflop_per_step": round(
+            moe_step_flops(cfg, nparams, batch, seq) / 1e12, 2),
+    }
+
+
+def classify_moe(rows, cfg, batch: int, seq: int) -> list:
+    """Best-effort bucket table from fusion operand shapes.
+
+    Priority matters: expert-FFN einsums mention the [E,C,M] activation,
+    dispatch/combine einsums the [T,E,C] one-hot tensors; the [E,C,H]
+    buffer boundary alone is ambiguous and stays unattributed rather
+    than guessed. Sorts split by width: the router's top-k sorts [T,E],
+    the gather path's routing argsort runs at [T·K].
+    """
+    t = batch * seq
+    e, k = cfg.n_experts, cfg.experts_per_token
+    c = _capacity(cfg, batch, seq)
+    m, h = cfg.mlp_dim, cfg.hidden
+    sig_ffn = (f"{e},{c},{m}", f"{c},{m}", f"{m},{h}", f"{h},{m}")
+    sig_disp = (f"{t},{e},{c}", f"{e},{c},{t}")
+    sig_router = (f"{t},{e}]", f"{t},{e}}}")
+    totals = {b: [0.0, 0.0, 0.0, 0.0] for b in MOE_BUCKETS}  # ms, pct, gb, tf
+
+    def bucket(r) -> str:
+        name = r["name"]
+        ln = r.get("long", "") + " " + r.get("shape", "")
+        if "flash" in name or "attention" in name:
+            return "attention"
+        if any(s in ln for s in sig_disp):
+            return "dispatch_combine"
+        if "sort" in name or "scatter" in name or "gather" in name:
+            # gather-path routing runs at T·K width; router top-k at [T,E]
+            if f"{t * k}" in ln:
+                return "dispatch_combine"
+            return "router_topk_aux"
+        if any(s in ln for s in sig_ffn):
+            return "expert_ffn"
+        if any(s in ln for s in sig_router):
+            return "router_topk_aux"
+        if "adam" in name or "loop_fusion" in name:
+            return "optimizer_elementwise"
+        return "unattributed"
+
+    for r in rows:
+        g = totals[bucket(r)]
+        g[0] += r["ms_per_step"]
+        g[1] += r["pct"]
+        g[2] += r["gbps"] * r["ms_per_step"] / 1e3        # GB moved
+        g[3] += r["tflops"] * r["ms_per_step"] / 1e3      # TFLOP done
+    out = []
+    for b in MOE_BUCKETS:
+        ms, pct, gb, tf = totals[b]
+        out.append({
+            "bucket": b,
+            "ms_per_step": round(ms, 2),
+            "pct": round(pct, 1),
+            "gbps": round(gb / (ms / 1e3), 1) if ms else 0.0,
+            "tflops": round(tf / (ms / 1e3), 2) if ms else 0.0,
+        })
+    return out
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--preset", default="512m", choices=("512m", "tiny"))
+    ap.add_argument("--dispatch", default="einsum",
+                    choices=("einsum", "gather"))
+    ap.add_argument("--peak-tflops", type=float, default=197.0)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from bench import bench_config_fingerprint, bench_environment, detect_chip
+
+    step, state, batch_d, cfg, ctx = build_moe_step(
+        args.preset, args.batch, args.seq, args.dispatch)
+    for _ in range(3):
+        state, m = step(state, batch_d)
+    float(m["loss"])  # host sync: block_until_ready lies on axon
+    outdir = tempfile.mkdtemp(prefix="moe-profile-")
+    with jax.profiler.trace(outdir):
+        for _ in range(args.steps):
+            state, m = step(state, batch_d)
+        float(m["loss"])
+    ctx.__exit__(None, None, None)
+    traces = sorted(glob.glob(os.path.join(
+        outdir, "**", "*.trace.json.gz"), recursive=True),
+        key=os.path.getmtime)
+    if not traces:
+        raise SystemExit(f"no trace produced under {outdir}")
+    print(f"trace: {traces[-1]}", file=sys.stderr)
+
+    summary = parse_trace(traces[-1], args.steps, top=None, with_long=True)
+    summary["moe_buckets"] = classify_moe(summary["top_ops"], cfg,
+                                          args.batch, args.seq)
+    # Classification done: the artifact keeps the 20 biggest ops, sans
+    # the long_name blobs.
+    summary["top_ops"] = [
+        {k: v for k, v in r.items() if k != "long"}
+        for r in summary["top_ops"][:20]]
+
+    B, S = args.batch, args.seq
+    nparams = sum(x.size for x in jax.tree.leaves(state.params))
+    model_tflop = moe_step_flops(cfg, nparams, B, S) / 1e12
+    dev_s = summary["device_ms_per_step"] / 1e3
+    summary["params"] = nparams
+    summary["params_active"] = active_param_count(cfg, nparams)
+    summary["nominal_tflop_per_step"] = round(model_tflop, 3)
+    summary["nominal_mfu_active_pct"] = round(
+        model_tflop / dev_s / args.peak_tflops * 100, 1) if dev_s else 0.0
+    summary["tokens_per_sec_device"] = round(B * S / dev_s) if dev_s else 0
+    summary["dispatch"] = args.dispatch
+    summary["analytic"] = analytic_dispatch_budget(cfg, B, S, nparams)
+    summary["batch_size"] = B
+    config = {"preset": args.preset, "batch": B, "seq": S,
+              "dispatch": args.dispatch, "steps": args.steps,
+              "capacity_factor": cfg.capacity_factor,
+              "n_experts": cfg.n_experts,
+              "experts_per_token": cfg.experts_per_token}
+    summary["config"] = config
+    summary["env"] = bench_environment(detect_chip())
+    summary["config_fingerprint"] = bench_config_fingerprint(config)
+    out = json.dumps(summary, indent=2)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out)
+
+
+if __name__ == "__main__":
+    main()

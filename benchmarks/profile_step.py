@@ -61,8 +61,14 @@ def capture_trace(steps: int, outdir: str, stem: str = "conv7") -> str:
     return traces[-1]
 
 
-def parse_trace(path: str, steps: int) -> dict:
-    """Aggregate the device 'XLA Ops' track into a step budget."""
+def parse_trace(path: str, steps: int, top: int = 20,
+                with_long: bool = False) -> dict:
+    """Aggregate the device 'XLA Ops' track into a step budget.
+
+    ``top`` bounds the per-op rows (None = all); ``with_long`` attaches
+    each row's truncated HLO long_name (operand shapes) so callers like
+    profile_moe.py can classify fusions into model-level buckets.
+    """
     with gzip.open(path) as f:
         data = json.load(f)
     ev = data["traceEvents"]
@@ -73,6 +79,17 @@ def parse_trace(path: str, steps: int) -> dict:
                if e.get("ph") == "M" and e.get("name") == "thread_name"
                and e.get("args", {}).get("name") == "XLA Ops"
                and e["pid"] in device_pids}
+    if not op_tids:
+        # CPU fallback: the TFRT CPU client emits per-op events on its
+        # own thread (names like "tf_XLATfrtCpuClient/..."), carrying
+        # hlo_op but no hlo_category/bytes_accessed/model_flops — times
+        # aggregate, byte/FLOP columns read 0. This keeps the profile
+        # artifact schema pinnable by host-only tier-1 smoke runs
+        # (tests/test_bench_moe.py); real budgets need the chip.
+        op_tids = {(e["pid"], e["tid"]) for e in ev
+                   if e.get("ph") == "M" and e.get("name") == "thread_name"
+                   and "XLATfrtCpuClient" in
+                   str(e.get("args", {}).get("name", ""))}
     ops = [e for e in ev if e.get("ph") == "X"
            and (e.get("pid"), e.get("tid")) in op_tids]
     if not ops:
@@ -130,9 +147,11 @@ def parse_trace(path: str, steps: int) -> dict:
     for name, (_, _, _, _, ln) in per_op.items():
         m = re.search(r"= \(?([a-z0-9]+\[[^\]]*\])", ln)
         shape_of[name] = m.group(1) if m else "?"
-    top_ops = rows(per_op, top=20)
+    top_ops = rows(per_op, top=top)
     for r in top_ops:
         r["shape"] = shape_of.get(r["name"], "?")
+        if with_long:
+            r["long"] = per_op[r["name"]][4]
 
     hist_total = sum(hist.values()) or 1.0
     return {

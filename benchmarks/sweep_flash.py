@@ -1,0 +1,115 @@
+"""Flash-attention tile-size sweep (round-6 satellite; VERDICT round-5
+"Next round" #5 groundwork).
+
+The round-5 roofline attributes ~100 ms/step of the Llama budget to the
+flash kernel running at ~35% of peak and calls that "kernel-structural
+at S=2048" — on the evidence of a single round-2 sweep that only tried
+128-square blocks against the 512/1024 defaults. This tool produces the
+full measured grid: fwd and fwd+bwd de-drifted timings for every
+(block_q, block_k) tiling that divides the shape, plus the XLA
+reference attention row, so the structural claim (or a better default)
+rests on a table instead of a memory.
+
+    python benchmarks/sweep_flash.py [--seq 2048] [--batch 8]
+        [--blocks-q 128,256,512,1024,2048] [--blocks-k ...]
+
+Off-TPU the kernel only runs in interpret mode (orders of magnitude
+slow): pass --interpret with a small --seq to smoke the harness; timing
+rows are labeled with the platform so interpreted numbers can never be
+mistaken for kernel measurements.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import timing  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="GQA KV heads (default = --heads, MHA)")
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--blocks-q", default="128,256,512,1024,2048")
+    ap.add_argument("--blocks-k", default="128,256,512,1024,2048")
+    ap.add_argument("--interpret", action="store_true",
+                    help="run the pallas kernel in interpret mode "
+                         "(off-TPU smoke; NOT a measurement)")
+    ap.add_argument("--fwd-only", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.ops.flash_attention import (
+        flash_attention,
+        flash_supported,
+        on_tpu,
+    )
+    from tf_operator_tpu.ops.layers import attention, repeat_kv
+
+    if not on_tpu() and not args.interpret:
+        print("no TPU: pass --interpret (with a small --seq) to smoke "
+              "the harness in interpret mode", file=sys.stderr)
+        return 1
+
+    b, s, h, d = args.batch, args.seq, args.heads, args.head_dim
+    h_kv = args.kv_heads or h
+    platform = jax.devices()[0].platform
+    dtype = jnp.bfloat16 if on_tpu() else jnp.float32
+    kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, s, h, d), dtype)
+    k = jax.random.normal(kk, (b, s, h_kv, d), dtype)
+    v = jax.random.normal(kv_, (b, s, h_kv, d), dtype)
+
+    def time_fn(fn):
+        fwd = jax.jit(fn)
+        row = {"fwd_ms": round(timing.timed(fwd, q, k, v) * 1e3, 2)}
+        if not args.fwd_only:
+            grad = jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
+                argnums=(0, 1, 2)))
+            row["fwd_bwd_ms"] = round(
+                timing.timed(grad, q, k, v) * 1e3, 2)
+        return row
+
+    # XLA reference row (repeats KV to full heads itself for GQA)
+    def xla_ref(q, k, v):
+        group = q.shape[2] // k.shape[2]
+        if group > 1:
+            k, v = repeat_kv(k, group), repeat_kv(v, group)
+        return attention(q, k, v, causal=True)
+
+    base = {"batch": b, "seq": s, "heads": h, "kv_heads": h_kv,
+            "head_dim": d, "platform": platform,
+            "interpret": bool(args.interpret and not on_tpu())}
+    print(json.dumps({**base, "impl": "xla_reference", **time_fn(xla_ref)}),
+          flush=True)
+
+    for bq in (int(x) for x in args.blocks_q.split(",")):
+        for bk in (int(x) for x in args.blocks_k.split(",")):
+            if not flash_supported(s, s, d, bq, bk):
+                continue
+
+            def flash(q, k, v, bq=bq, bk=bk):
+                return flash_attention(q, k, v, causal=True, block_q=bq,
+                                       block_k=bk,
+                                       interpret=not on_tpu())
+
+            print(json.dumps({**base, "impl": "flash", "block_q": bq,
+                              "block_k": bk, **time_fn(flash)}),
+                  flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,14 @@ def main() -> int:
     ap.add_argument("--seq-len", type=int, default=64)
     ap.add_argument("--ep", type=int, default=1)
     ap.add_argument("--num-slices", type=int, default=1)
+    ap.add_argument("--dispatch", choices=["einsum", "gather"],
+                    default="einsum",
+                    help="MoE routing implementation (numerics-"
+                         "equivalent; see docs/benchmarks.md MoE "
+                         "roofline)")
     args = ap.parse_args()
+
+    import dataclasses
 
     import jax
     import jax.numpy as jnp
@@ -49,6 +56,7 @@ def main() -> int:
         cfg = mixtral_8x7b()
     else:
         cfg = mixtral_tiny(max_seq_len=args.seq_len * 2)
+    cfg = dataclasses.replace(cfg, dispatch=args.dispatch)
 
     mesh = make_mesh(MeshConfig(dcn=args.num_slices, dp=-1, ep=args.ep))
     print("mesh:", dict(mesh.shape))

@@ -1,0 +1,143 @@
+"""MoE bench/profile artifact-schema pins (round-6 CI satellite).
+
+Mirrors tests/test_bench.py / test_bench_controlplane.py: the tiny
+preset runs on CPU in seconds, so a refactor that breaks the harness or
+silently changes the one-JSON-line artifact schema fails tier-1, not
+the next chip-attached benchmarking round. On CPU the profile's
+byte/FLOP columns read 0 (the trace carries no counters — parse_trace's
+documented CPU fallback); the schema is identical to the chip run.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench_moe  # noqa: E402
+import profile_moe  # noqa: E402
+
+# Every key a round-over-round consumer may read. Additions are fine;
+# removals/renames break the audit trail and must show up here.
+BENCH_KEYS = {
+    "what", "dispatch", "ms_per_step", "ms_per_step_single_block",
+    "tokens_per_sec", "params_total", "params_active",
+    "model_mfu_active", "env", "config_fingerprint",
+}
+PROFILE_KEYS = {
+    "steps", "device_ms_per_step", "bytes_per_step_gb",
+    "model_tflop_per_step", "categories", "top_ops", "moe_buckets",
+    "params", "params_active", "nominal_tflop_per_step",
+    "nominal_mfu_active_pct", "tokens_per_sec_device", "dispatch",
+    "analytic", "batch_size", "config", "env", "config_fingerprint",
+}
+ANALYTIC_KEYS = {
+    "capacity", "dispatch_einsum_tflop_per_step_fwd",
+    "dispatch_einsum_tflop_per_step_fwd_bwd",
+    "routing_tensor_gb_per_layer", "expert_ffn_tflop_per_step_fwd",
+    "gather_buffer_gb_per_layer", "model_tflop_per_step",
+}
+ENV_KEYS = {"jax_version", "platform", "chip_kind", "python"}
+
+# batch 8: tier-1 runs under the conftest's 8-virtual-device CPU mesh,
+# and the bench's dp=-1 mesh absorbs every device it sees.
+SMOKE = ["--preset", "tiny", "--batch", "8", "--seq", "64", "--steps", "2"]
+
+
+@pytest.fixture(scope="module")
+def bench_artifacts():
+    """One smoke bench run per dispatch mode, shared by the schema and
+    fingerprint pins (the runs dominate this module's tier-1 cost)."""
+    import contextlib
+    import io
+
+    artifacts = {}
+    for dispatch in ("einsum", "gather"):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = bench_moe.main(SMOKE + ["--dispatch", dispatch])
+        assert rc == 0
+        lines = buf.getvalue().strip().splitlines()
+        assert len(lines) == 1, "artifact must be exactly one JSON line"
+        artifacts[dispatch] = json.loads(lines[0])
+    return artifacts
+
+
+@pytest.mark.parametrize("dispatch", ["einsum", "gather"])
+def test_bench_moe_artifact_schema(bench_artifacts, dispatch):
+    artifact = bench_artifacts[dispatch]
+    assert BENCH_KEYS <= set(artifact), (
+        f"missing keys: {BENCH_KEYS - set(artifact)}")
+    assert artifact["dispatch"] == dispatch
+    assert artifact["tokens_per_sec"] > 0
+    assert artifact["params_active"] < artifact["params_total"]
+    assert ENV_KEYS <= set(artifact["env"])
+    assert len(artifact["config_fingerprint"]) == 12
+
+
+def test_bench_moe_fingerprint_tracks_dispatch(bench_artifacts):
+    """The dispatch mode is part of the measured config: einsum and
+    gather artifacts must never be comparable under one fingerprint."""
+    assert bench_artifacts["einsum"]["config_fingerprint"] != \
+        bench_artifacts["gather"]["config_fingerprint"]
+
+
+def test_profile_moe_artifact_schema(tmp_path, capsys):
+    out_file = tmp_path / "profile.json"
+    profile_moe.main(SMOKE + ["--dispatch", "gather",
+                              "--out", str(out_file)])
+    capsys.readouterr()  # drain the pretty-printed copy
+    artifact = json.loads(out_file.read_text())
+    assert PROFILE_KEYS <= set(artifact), (
+        f"missing keys: {PROFILE_KEYS - set(artifact)}")
+    assert artifact["dispatch"] == "gather"
+    assert artifact["device_ms_per_step"] > 0
+    assert ANALYTIC_KEYS <= set(artifact["analytic"])
+    buckets = {r["bucket"] for r in artifact["moe_buckets"]}
+    assert buckets == set(profile_moe.MOE_BUCKETS)
+    # bucket times account for all device time (unattributed included)
+    total = sum(r["ms_per_step"] for r in artifact["moe_buckets"])
+    assert total == pytest.approx(artifact["device_ms_per_step"],
+                                  rel=0.02)
+    assert len(artifact["top_ops"]) <= 20
+    assert all("long" not in r for r in artifact["top_ops"])
+    assert ENV_KEYS <= set(artifact["env"])
+
+
+def test_analytic_budget_512m_config():
+    """The structural numbers the docs roofline quotes, pinned: at the
+    bench config the one-hot dispatch/combine einsums execute ~2.2x the
+    CREDITED model FLOPs of the whole step, and >5x the expert-FFN
+    FLOPs they feed — the quantitative case for the gather path."""
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.mixtral import MixtralConfig
+
+    cfg = MixtralConfig(vocab_size=32768, hidden=1024, n_layers=8,
+                        n_heads=16, n_kv_heads=4, head_dim=128,
+                        mlp_dim=2048, n_experts=8, experts_per_token=2,
+                        max_seq_len=2048, remat=True)
+    assert cfg.dtype == jnp.bfloat16
+    budget = profile_moe.analytic_dispatch_budget(cfg, 8, 2048,
+                                                  nparams=512_000_000)
+    assert budget["capacity"] == 5120
+    assert budget["dispatch_einsum_tflop_per_step_fwd"] == pytest.approx(
+        21.99, abs=0.01)
+    assert budget["dispatch_einsum_tflop_per_step_fwd_bwd"] == \
+        pytest.approx(54.98, abs=0.01)
+    assert budget["expert_ffn_tflop_per_step_fwd"] == pytest.approx(
+        4.12, abs=0.01)
+    assert budget["routing_tensor_gb_per_layer"] == pytest.approx(
+        2.68, abs=0.01)
+    # the permutation the einsums implement moves ~9x fewer bytes
+    assert budget["gather_buffer_gb_per_layer"] < \
+        budget["routing_tensor_gb_per_layer"] / 8
+
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.compute

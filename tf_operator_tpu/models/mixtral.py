@@ -1,12 +1,30 @@
 """Mixtral-family MoE decoder (BASELINE config: "Mixtral 8x7B
 expert-parallel multi-slice v5p, DCN all-to-all").
 
-TPU-first MoE: GShard-style dense einsum dispatch — router top-k picks
-experts, tokens are packed into per-expert capacity buffers with one-hot
-dispatch/combine tensors, expert FFNs run as batched einsums over a
-leading expert dim. Expert params shard over the ``ep`` mesh axis
-(MOE_RULES), so XLA lowers the dispatch/combine einsums to all-to-alls
-(ICI within a slice, DCN across slices) — no hand-written comm.
+TPU-first MoE with two numerics-equivalent dispatch implementations
+selected by ``MixtralConfig.dispatch``:
+
+- ``"einsum"`` (default): GShard-style dense einsum dispatch — router
+  top-k picks experts, tokens are packed into per-expert capacity
+  buffers with one-hot dispatch/combine tensors contracted by dense
+  einsums. Simple and GSPMD-friendly, but the one-hot contractions
+  execute O(T·E·C·H) matmul FLOPs and move O(T·E·C) bytes for what is
+  fundamentally a permutation — at the bench config that is ~5× the
+  expert FFN FLOPs (docs/benchmarks.md MoE roofline).
+- ``"gather"``: sort/gather token routing — a stable argsort of the
+  (token, slot) assignments by expert, a row-gather into the identical
+  capacity-packed [E, C, H] buffers, and a weighted inverse-permutation
+  scatter to combine. Same capacity dropping (the stable sort preserves
+  the einsum path's token-major priority order), same top-k probs, same
+  aux loss; the routing tensors shrink from O(T·E·C) floats to O(T·K)
+  integers and the permutation costs gather/scatter bandwidth instead
+  of matmul FLOPs.
+
+Both paths run the identical batched expert FFN einsums over a leading
+expert dim. Expert params shard over the ``ep`` mesh axis (MOE_RULES),
+so XLA lowers the pack/unpack — einsum contractions or gather/scatter —
+to all-to-alls (ICI within a slice, DCN across slices); no hand-written
+comm.
 
 Shares the attention stack with the Llama family.
 """
@@ -40,6 +58,10 @@ class MixtralConfig:
     n_experts: int = 8
     experts_per_token: int = 2
     capacity_factor: float = 1.25
+    # Routing implementation: "einsum" (one-hot dispatch/combine einsums,
+    # the GShard formulation) or "gather" (argsort + gather/scatter token
+    # permutation). Numerics-equivalent; see module docstring.
+    dispatch: str = "einsum"
     aux_loss_weight: float = 0.02
     max_seq_len: int = 8192
     rope_theta: float = 1000000.0
@@ -70,14 +92,43 @@ def mixtral_tiny(vocab_size: int = 256, max_seq_len: int = 128) -> MixtralConfig
                          remat=False)
 
 
+def _aux_loss(probs: jax.Array, top_idx: jax.Array,
+              within_capacity: jax.Array, e: int, k: int) -> jax.Array:
+    """Load-balancing aux loss (Switch/GShard): E * sum_e f_e * P_e.
+
+    f counts only assignments that actually landed a capacity slot —
+    identical for both dispatch implementations because both derive
+    ``within_capacity`` from the same token-major priority order.
+    """
+    assigned = jnp.sum(jax.nn.one_hot(top_idx, e, dtype=jnp.float32)
+                       * within_capacity.astype(jnp.float32)[..., None],
+                       axis=1)                                   # [T, E]
+    f = jnp.mean(assigned, axis=0)                               # frac routed
+    p = jnp.mean(probs, axis=0)
+    return e * jnp.sum(f * p) / k
+
+
 class MoELayer(nn.Module):
-    """Token-choice top-k routing with capacity; dense einsum dispatch."""
+    """Token-choice top-k routing with capacity.
+
+    ``config.dispatch`` selects the routing implementation:
+    ``"einsum"`` contracts one-hot [T,E,C] dispatch/combine tensors with
+    dense einsums; ``"gather"`` routes by stable sort + gather/scatter.
+    Both produce identical capacity drops, outputs, grads, and aux loss
+    (pinned by tests/test_moe_dispatch.py). Dropped-assignment counts
+    are sown into the "intermediates" collection as
+    ``dropped_assignments`` when that collection is mutable.
+    """
 
     config: MixtralConfig
 
     @nn.compact
     def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
         cfg = self.config
+        if cfg.dispatch not in ("einsum", "gather"):
+            raise ValueError(
+                f"MixtralConfig.dispatch must be 'einsum' or 'gather', "
+                f"got {cfg.dispatch!r}")
         b, s, h = x.shape
         t = b * s
         e = cfg.n_experts
@@ -95,49 +146,133 @@ class MoELayer(nn.Module):
         top_probs = top_probs / jnp.maximum(
             jnp.sum(top_probs, axis=-1, keepdims=True), 1e-9)
 
-        # capacity positions: for each (expert, k) assignment, this token's
-        # slot is the count of earlier tokens choosing the same expert
-        expert_onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.int32)  # [T,K,E]
-        flat_assign = expert_onehot.reshape(t * k, e)
-        position = (jnp.cumsum(flat_assign, axis=0) - flat_assign)    # [T*K,E]
-        position = jnp.sum(position * flat_assign, axis=-1).reshape(t, k)
-        within_capacity = position < capacity                    # [T, K]
-
-        # dispatch [T, E, C] / combine [T, E, C]
-        pos_onehot = jax.nn.one_hot(position, capacity,
-                                    dtype=x.dtype)               # [T,K,C]
-        disp = (expert_onehot.astype(x.dtype)[..., None]
-                * pos_onehot[:, :, None, :]
-                * within_capacity.astype(x.dtype)[:, :, None, None])
-        dispatch = jnp.sum(disp, axis=1)                         # [T,E,C]
-        combine = jnp.sum(disp * top_probs.astype(x.dtype)[:, :, None, None],
-                          axis=1)                                # [T,E,C]
-
-        # expert buffers + batched expert FFNs (leading dim e -> ep axis)
-        expert_in = jnp.einsum("tec,th->ech", dispatch, xt,
-                               preferred_element_type=jnp.float32
-                               ).astype(cfg.dtype)               # [E,C,H]
+        # Expert params exist identically under either dispatch (same
+        # names/shapes — checkpoints are interchangeable across modes).
         w_gate = self.param("w_gate", nn.initializers.lecun_normal(),
                             (e, h, cfg.mlp_dim), jnp.float32)
         w_up = self.param("w_up", nn.initializers.lecun_normal(),
                           (e, h, cfg.mlp_dim), jnp.float32)
         w_down = self.param("w_down", nn.initializers.lecun_normal(),
                             (e, cfg.mlp_dim, h), jnp.float32)
-        gate = jnp.einsum("ech,ehm->ecm", expert_in, w_gate.astype(cfg.dtype))
-        up = jnp.einsum("ech,ehm->ecm", expert_in, w_up.astype(cfg.dtype))
-        act = nn.silu(gate) * up
-        expert_out = jnp.einsum("ecm,emh->ech", act,
-                                w_down.astype(cfg.dtype))        # [E,C,H]
 
-        y = jnp.einsum("tec,ech->th", combine, expert_out)
+        def expert_ffn(expert_in: jax.Array) -> jax.Array:
+            """Batched expert FFNs [E,C,H] -> [E,C,H] (leading dim e ->
+            ep axis); shared verbatim by both dispatch paths."""
+            gate = jnp.einsum("ech,ehm->ecm", expert_in,
+                              w_gate.astype(cfg.dtype))
+            up = jnp.einsum("ech,ehm->ecm", expert_in,
+                            w_up.astype(cfg.dtype))
+            act = nn.silu(gate) * up
+            return jnp.einsum("ecm,emh->ech", act,
+                              w_down.astype(cfg.dtype))          # [E,C,H]
+
+        if cfg.dispatch == "gather":
+            y, within_capacity = _gather_route(
+                xt, top_idx, top_probs, capacity, expert_ffn, cfg)
+        else:
+            y, within_capacity = _einsum_route(
+                xt, top_idx, top_probs, capacity, expert_ffn, cfg)
         y = y.reshape(b, s, h).astype(x.dtype)
 
-        # load-balancing aux loss (Switch/GShard): E * sum_e f_e * P_e
-        assigned = jnp.sum(dispatch, axis=-1)                    # [T, E]
-        f = jnp.mean(assigned.astype(jnp.float32), axis=0)       # frac routed
-        p = jnp.mean(probs, axis=0)
-        aux = e * jnp.sum(f * p) / k
+        self.sow("intermediates", "dropped_assignments",
+                 jnp.sum((~within_capacity).astype(jnp.int32)))
+        aux = _aux_loss(probs, top_idx, within_capacity, e, k)
         return y, aux
+
+
+def _einsum_route(xt, top_idx, top_probs, capacity, expert_ffn, cfg):
+    """GShard one-hot dispatch: [T,E,C] routing tensors + dense einsums.
+
+    O(T·E·C·H) matmul FLOPs and O(T·E·C) routing-tensor bytes per layer
+    — the cost the gather path removes (docs/benchmarks.md MoE
+    roofline).
+    """
+    t, h = xt.shape
+    e, k = cfg.n_experts, cfg.experts_per_token
+    x_dtype = xt.dtype
+
+    # capacity positions: for each (token, k) assignment, this token's
+    # slot is the count of earlier assignments choosing the same expert
+    expert_onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.int32)  # [T,K,E]
+    flat_assign = expert_onehot.reshape(t * k, e)
+    position = (jnp.cumsum(flat_assign, axis=0) - flat_assign)    # [T*K,E]
+    position = jnp.sum(position * flat_assign, axis=-1).reshape(t, k)
+    within_capacity = position < capacity                    # [T, K]
+
+    # dispatch [T, E, C] / combine [T, E, C]
+    pos_onehot = jax.nn.one_hot(position, capacity,
+                                dtype=x_dtype)               # [T,K,C]
+    disp = (expert_onehot.astype(x_dtype)[..., None]
+            * pos_onehot[:, :, None, :]
+            * within_capacity.astype(x_dtype)[:, :, None, None])
+    dispatch = jnp.sum(disp, axis=1)                         # [T,E,C]
+    combine = jnp.sum(disp * top_probs.astype(x_dtype)[:, :, None, None],
+                      axis=1)                                # [T,E,C]
+
+    expert_in = jnp.einsum("tec,th->ech", dispatch, xt,
+                           preferred_element_type=jnp.float32
+                           ).astype(cfg.dtype)               # [E,C,H]
+    expert_out = expert_ffn(expert_in)                       # [E,C,H]
+    y = jnp.einsum("tec,ech->th", combine, expert_out)
+    return y, within_capacity
+
+
+def _gather_route(xt, top_idx, top_probs, capacity, expert_ffn, cfg):
+    """Sort/gather dispatch: route tokens by a stable argsort on their
+    expert choice, gather rows into the capacity-packed [E,C,H] buffers,
+    and combine via a weighted inverse-permutation scatter.
+
+    The stable sort preserves the (token-major, slot-minor) assignment
+    order the einsum path's cumsum ranks by, so capacity positions —
+    and therefore which assignments drop — are identical. Routing state
+    is O(T·K) integers instead of O(T·E·C) floats, and the permutation
+    costs gather/scatter bandwidth instead of matmul FLOPs.
+    """
+    from tf_operator_tpu.parallel.sharding import MOE_RULES, constrain
+
+    t, h = xt.shape
+    e, k = cfg.n_experts, cfg.experts_per_token
+    x_dtype = xt.dtype
+    tk = t * k
+
+    flat_expert = top_idx.reshape(tk)                        # [T*K]
+    order = jnp.argsort(flat_expert, stable=True)            # [T*K]
+    sorted_expert = jnp.take(flat_expert, order)             # [T*K]
+    # rank within expert = index - segment start (same count-of-earlier-
+    # assignments the einsum path computes with its one-hot cumsum)
+    counts = jnp.zeros((e,), jnp.int32).at[flat_expert].add(1)
+    seg_start = jnp.cumsum(counts) - counts                  # [E]
+    pos_sorted = (jnp.arange(tk, dtype=jnp.int32)
+                  - jnp.take(seg_start, sorted_expert))      # [T*K]
+    keep = pos_sorted < capacity                             # [T*K] (sorted)
+    slot = sorted_expert * capacity + pos_sorted             # [T*K]
+    src_tok = order // k                                     # [T*K]
+
+    # dispatch: row-gather tokens into capacity-packed expert buffers;
+    # over-capacity assignments scatter to an out-of-range slot and drop
+    gathered = jnp.take(xt, src_tok, axis=0).astype(cfg.dtype)   # [T*K,H]
+    expert_in = jnp.zeros((e * capacity, h), cfg.dtype).at[
+        jnp.where(keep, slot, e * capacity)].set(
+        gathered, mode="drop").reshape(e, capacity, h)       # [E,C,H]
+    expert_in = constrain(expert_in, ("expert", "capacity", None),
+                          MOE_RULES)
+    expert_out = expert_ffn(expert_in)                       # [E,C,H]
+    expert_out = constrain(expert_out, ("expert", "capacity", None),
+                           MOE_RULES)
+
+    # combine: weighted gather back through the inverse permutation,
+    # then sum each token's K slot contributions
+    out_rows = jnp.take(expert_out.reshape(e * capacity, h),
+                        jnp.where(keep, slot, 0), axis=0)    # [T*K,H]
+    w = jnp.take(top_probs.reshape(tk), order).astype(x_dtype)
+    contrib = out_rows * jnp.where(keep, w, 0)[:, None]      # [T*K,H]
+    unsorted = jnp.zeros((tk, contrib.shape[-1]),
+                         contrib.dtype).at[order].set(contrib)
+    y = jnp.sum(unsorted.reshape(t, k, -1), axis=1)          # [T,H]
+
+    within_capacity = jnp.zeros((tk,), jnp.bool_).at[order].set(
+        keep).reshape(t, k)
+    return y, within_capacity
 
 
 class MixtralBlock(nn.Module):

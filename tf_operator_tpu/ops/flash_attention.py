@@ -44,6 +44,11 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) or \
 
 NEG_INF = -1e30
 _LANES = 128
+# Default tile sizes (swept in round 2: 512/1024 beat 128-blocks 2x on
+# the bench chip — grid overhead; benchmarks/sweep_flash.py re-measures
+# the full fwd/bwd grid so the claim stays testable per-platform).
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 # Row statistics (lse, delta) are carried as [..., S, _SUBS] instead of
 # [..., S]: TPU blocks need their last two dims (sublanes, lanes) either
 # 8/128-aligned or equal to the array dims, so a (block_q,) row vector
@@ -389,7 +394,8 @@ def _fit_block(seq: int, want: int) -> int:
 
 
 def flash_supported(q_seq: int, k_seq: int, head_dim: int,
-                    block_q: int = 512, block_k: int = 1024) -> bool:
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> bool:
     """Shapes must tile into sublane-aligned blocks; head_dim must fill
     MXU lanes."""
     bq, bk = _fit_block(q_seq, block_q), _fit_block(k_seq, block_k)
@@ -412,7 +418,8 @@ def on_tpu() -> bool:
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, q_offset: int = 0,
-                    block_q: int = 512, block_k: int = 1024,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False) -> jax.Array:
     """Flash attention over [B, S, H, D] tensors (same layout as
     ``ops.layers.attention``). GQA: k/v may carry fewer heads
@@ -439,6 +446,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                             mesh, causal: bool = True, q_offset: int = 0,
                             head_axis: str = "tp",
+                            block_q: int = DEFAULT_BLOCK_Q,
+                            block_k: int = DEFAULT_BLOCK_K,
                             interpret: bool = False) -> jax.Array:
     """Flash attention under GSPMD: a pallas_call is an opaque custom
     call with no partitioning rule, so inside a sharded jit it must go
@@ -452,7 +461,8 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
              head_axis if head_axis in mesh.axis_names else None, None)
     fn = shard_map(
         functools.partial(flash_attention, causal=causal,
-                          q_offset=q_offset, interpret=interpret),
+                          q_offset=q_offset, block_q=block_q,
+                          block_k=block_k, interpret=interpret),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
@@ -460,7 +470,9 @@ def flash_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def best_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    causal: bool = True, q_offset: int = 0,
-                   mesh=None, force_flash: bool = False) -> jax.Array:
+                   mesh=None, force_flash: bool = False,
+                   block_q: int = DEFAULT_BLOCK_Q,
+                   block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
     """Dispatch: pallas flash on TPU when shapes tile (through shard_map
     when a mesh is active so GSPMD can partition it), else the XLA
     reference. Accepts GQA kv (fewer heads); the XLA fallback repeats
@@ -482,7 +494,8 @@ def best_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     auto_ok = (on_tpu() and sp_size == 1
                and q.shape[2] % tp_size == 0
                and k.shape[2] % tp_size == 0
-               and flash_supported(q.shape[1], k.shape[1], q.shape[3]))
+               and flash_supported(q.shape[1], k.shape[1], q.shape[3],
+                                   block_q, block_k))
     if force_flash or auto_ok:
         interpret = not on_tpu()
         if mesh is not None:
@@ -493,8 +506,11 @@ def best_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 k, v = repeat_kv(k, group), repeat_kv(v, group)
             return flash_attention_sharded(q, k, v, mesh, causal=causal,
                                            q_offset=q_offset,
+                                           block_q=block_q,
+                                           block_k=block_k,
                                            interpret=interpret)
         return flash_attention(q, k, v, causal=causal, q_offset=q_offset,
+                               block_q=block_q, block_k=block_k,
                                interpret=interpret)
     group = q.shape[2] // k.shape[2]
     if group > 1:

@@ -35,9 +35,15 @@ LLAMA_RULES: Rules = {
 }
 
 # MoE (Mixtral family): experts sharded over ep, expert-internal mlp over tp.
+# "capacity" names the slot dim of the gather-dispatch permutation
+# intermediates (models/mixtral.py _gather_route): the capacity-packed
+# [expert, capacity, embed] buffers shard their expert dim over ep —
+# so the pack/unpack gather+scatter lowers to all-to-alls exactly like
+# the one-hot dispatch einsums — while slots stay local to the expert.
 MOE_RULES: Rules = {
     **LLAMA_RULES,
     "expert": "ep",
+    "capacity": None,
 }
 
 # Conv/vision nets (ResNet): pure data parallel; params replicated.
@@ -75,3 +81,17 @@ def shard_pytree(tree, mesh: Mesh, axes_tree, rules: Rules):
 def batch_sharding(mesh: Mesh, rules: Rules = LLAMA_RULES) -> NamedSharding:
     """Sharding for [batch, ...] host data."""
     return NamedSharding(mesh, P(rules.get("batch")))
+
+
+def constrain(x, logical_axes: Sequence[Optional[str]], rules: Rules):
+    """``with_sharding_constraint`` via logical axes against the ambient
+    mesh (``mesh.use_mesh``); identity when no mesh is active, so model
+    code can pin activation intermediates (e.g. the MoE gather-dispatch
+    expert buffers) unconditionally."""
+    from tf_operator_tpu.parallel.mesh import active_mesh
+
+    mesh = active_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, logical_axes, rules))
