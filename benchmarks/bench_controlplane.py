@@ -399,6 +399,296 @@ def run_tenant_bench(tenants: int, jobs_per_tenant: int, workers: int,
     }
 
 
+class CkptFakeKubelet(FakeKubelet):
+    """FakeKubelet that also plays the checkpointing WORKER + node agent
+    (the data-plane relay the local backend provides in production):
+    Running pods advance one training step per tick, publish periodic
+    saves and barrier acks as CheckpointRecords, and a recreated pod
+    resumes from the TPUJOB_RESTORE_STEP env the controller rendered —
+    so the disruption scenario measures the full save-before-evict /
+    restore-with-identity loop with no subprocess in it."""
+
+    def __init__(self, store: Store, steps: int, tick: float = 0.01,
+                 admitted=None, save_interval: int = 20):
+        super().__init__(store, tick=tick, admitted=admitted)
+        self.steps = steps
+        self.save_interval = save_interval
+        # (ns, pod) -> training progress of the CURRENT incarnation
+        # (keyed by uid so a recreate re-reads its restore env).
+        self._progress: Dict[Tuple[str, str, str], int] = {}
+        self._acked: Dict[Tuple[str, str, str], str] = {}
+
+    def run(self) -> None:  # overrides FakeKubelet.run
+        from tf_operator_tpu.api.types import (
+            CheckpointRecord,
+            CheckpointRecordStatus,
+        )
+
+        while not self._stop.is_set():
+            pods = self.store.list(store_mod.PODS, namespace=NAMESPACE)
+            for pod in pods:
+                if pod.status.phase == PodPhase.PENDING:
+                    job_name = pod.metadata.labels.get(
+                        constants.LABEL_JOB_NAME, "")
+                    if (self.admitted is not None
+                            and not self.admitted(pod.metadata.namespace,
+                                                  job_name)):
+                        continue
+                    self._start(pod)
+                elif pod.status.phase == PodPhase.RUNNING:
+                    self._step(pod, CheckpointRecord,
+                               CheckpointRecordStatus)
+            self._stop.wait(self.tick)
+
+    def _key(self, pod) -> Tuple[str, str, str]:
+        return (pod.metadata.namespace, pod.metadata.name,
+                pod.metadata.uid)
+
+    def _start(self, pod) -> None:
+        restore = 0
+        for c in pod.spec.containers:
+            if constants.ENV_RESTORE_STEP in c.env:
+                restore = int(c.env[constants.ENV_RESTORE_STEP])
+        self._progress[self._key(pod)] = restore
+        patch = Pod(metadata=ObjectMeta(name=pod.metadata.name,
+                                        namespace=pod.metadata.namespace))
+        patch.status = PodStatus(phase=PodPhase.RUNNING,
+                                 start_time=testutil.now())
+        try:
+            self.store.update_status(store_mod.PODS, patch)
+        except (store_mod.NotFoundError, store_mod.ConflictError):
+            pass
+
+    def _step(self, pod, record_cls, status_cls) -> None:
+        key = self._key(pod)
+        if key not in self._progress:
+            self._start(pod)  # Running before we saw it Pending
+            return
+        self._progress[key] += 1
+        progress = self._progress[key]
+        notice = pod.metadata.annotations.get(
+            constants.ANNOTATION_PREEMPT_NOTICE, "")
+        barrier = ""
+        if notice and self._acked.get(key) != notice:
+            barrier = json.loads(notice).get("barrier", "")
+        periodic = progress % self.save_interval == 0
+        if barrier or periodic or progress >= self.steps:
+            self._publish(pod, progress, barrier, record_cls, status_cls)
+            if barrier:
+                self._acked[key] = notice
+        if progress >= self.steps:
+            patch = Pod(metadata=ObjectMeta(
+                name=pod.metadata.name,
+                namespace=pod.metadata.namespace))
+            patch.status = PodStatus(
+                phase=PodPhase.SUCCEEDED, start_time=testutil.now(),
+                container_statuses=[ContainerStatus(
+                    name=constants.DEFAULT_CONTAINER_NAME,
+                    state="Terminated", exit_code=0)])
+            try:
+                self.store.update_status(store_mod.PODS, patch)
+            except (store_mod.NotFoundError, store_mod.ConflictError):
+                pass
+
+    def _publish(self, pod, progress: int, barrier: str,
+                 record_cls, status_cls) -> None:
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        status = status_cls(step=progress, progress_step=progress,
+                            barrier_id=barrier, directory="/bench/ckpt",
+                            save_seconds=0.001,
+                            updated_at=testutil.now())
+        try:
+            existing = self.store.try_get(store_mod.CHECKPOINTRECORDS,
+                                          ns, name)
+            if existing is None:
+                self.store.create(store_mod.CHECKPOINTRECORDS, record_cls(
+                    metadata=ObjectMeta(
+                        name=name, namespace=ns,
+                        labels={k: v
+                                for k, v in pod.metadata.labels.items()},
+                        owner_references=[r.deepcopy() for r in
+                                          pod.metadata.owner_references]),
+                    status=status))
+            else:
+                existing.status = status
+                self.store.update_status(store_mod.CHECKPOINTRECORDS,
+                                         existing)
+        except (store_mod.AlreadyExistsError, store_mod.ConflictError,
+                store_mod.NotFoundError):
+            pass  # raced; next periodic publish lands
+
+
+def run_disruption_bench(jobs: int, workers: int, threadiness: int,
+                         timeout: float, disruptions: int,
+                         steps: int = 80, save_interval: int = 20,
+                         chips_per_job: int = 4,
+                         barrier_timeout: float = 10.0,
+                         kubelet_tick: float = 0.01) -> Dict:
+    """Disruption/goodput scenario: checkpointing fake jobs under
+    injected drains. Each disruption takes the slice-health path —
+    ``ready_to_evict`` (opens the save-before-evict barrier), evict the
+    gang's pods once it answers True, ``gang.displace`` — against a live
+    CheckpointCoordinator; the rebound pods restore from the
+    barrier-committed step. Reports barrier outcomes, steps lost, and
+    the goodput ratio on top of the convergence numbers."""
+    from tf_operator_tpu.controller.ckpt import CheckpointCoordinator
+    from tf_operator_tpu.controller.engine import EngineConfig
+    from tf_operator_tpu.controller.gang import (
+        PHASE_INQUEUE,
+        PHASE_RUNNING,
+        SliceGangScheduler,
+    )
+    from tf_operator_tpu.api.types import CheckpointPolicy
+    from tf_operator_tpu.runtime import metrics
+
+    store = Store()
+    ckpt = CheckpointCoordinator(store).start()
+    gang = SliceGangScheduler(store, total_chips=None, ckpt=ckpt)
+    ckpt.on_ack = gang.readmit
+    controller = TPUJobController(
+        store, config=EngineConfig(enable_gang_scheduling=True),
+        gang=gang, namespace=NAMESPACE, ckpt=ckpt)
+
+    def group_admitted(ns: str, job_name: str) -> bool:
+        g = store.try_get(store_mod.SLICEGROUPS, ns, job_name)
+        return g is not None and g.status.phase in (PHASE_INQUEUE,
+                                                    PHASE_RUNNING)
+
+    timer = _SyncTimer(controller)
+    kubelet = CkptFakeKubelet(store, steps=steps, tick=kubelet_tick,
+                              admitted=group_admitted,
+                              save_interval=save_interval)
+
+    acked_before = metrics.checkpoint_barriers.value(
+        job_namespace=NAMESPACE, outcome="acked")
+    timeout_before = metrics.checkpoint_barriers.value(
+        job_namespace=NAMESPACE, outcome="timeout")
+    lost_sum_before = metrics.steps_lost_per_disruption.sum_value(
+        job_namespace=NAMESPACE)
+    lost_n_before = metrics.steps_lost_per_disruption.count_value(
+        job_namespace=NAMESPACE)
+
+    injected = [0]
+    disruptor_stop = threading.Event()
+
+    def disrupt() -> None:
+        """One disruption at a time, round-robin over live gangs: open
+        the barrier, then evict + displace the moment it completes —
+        the slice-health drain path, level-triggered just like it."""
+        cursor = 0
+        in_flight: Optional[str] = None
+        while not disruptor_stop.is_set() and injected[0] < disruptions:
+            target = in_flight
+            if target is None:
+                live = sorted(
+                    g.metadata.name
+                    for g in store.list(store_mod.SLICEGROUPS,
+                                        namespace=NAMESPACE)
+                    if g.status.phase in (PHASE_INQUEUE, PHASE_RUNNING)
+                    and not g.status.displaced_reason)
+                if not live:
+                    disruptor_stop.wait(kubelet_tick)
+                    continue
+                target = live[cursor % len(live)]
+                cursor += 1
+            if ckpt.ready_to_evict(NAMESPACE, target,
+                                   "bench disruption"):
+                for p in store.list(store_mod.PODS, namespace=NAMESPACE,
+                                    selector={constants.LABEL_JOB_NAME:
+                                              target}):
+                    if p.status.phase not in ("Succeeded", "Failed"):
+                        store.try_delete(store_mod.PODS, NAMESPACE,
+                                         p.metadata.name)
+                gang.displace(NAMESPACE, target, "bench disruption")
+                injected[0] += 1
+                in_flight = None
+            else:
+                in_flight = target  # barrier open; re-consult next tick
+            disruptor_stop.wait(kubelet_tick)
+
+    disruptor = threading.Thread(target=disrupt, name="disruptor",
+                                 daemon=True)
+
+    controller.run(threadiness=threadiness)
+    kubelet.start()
+    t0 = time.perf_counter()
+    try:
+        for i in range(jobs):
+            job = testutil.new_tpujob(worker=workers,
+                                      name=f"bench-{i:04d}",
+                                      namespace=NAMESPACE)
+            job.spec.slice.accelerator = f"v5e-{chips_per_job}"
+            job.spec.run_policy.checkpoint_policy = CheckpointPolicy(
+                enabled=True, directory="/bench/ckpt",
+                interval_steps=save_interval,
+                barrier_timeout_seconds=barrier_timeout)
+            store.create(store_mod.TPUJOBS, job)
+        disruptor.start()
+
+        deadline = t0 + timeout
+        while True:
+            succeeded = sum(store.project(
+                store_mod.TPUJOBS,
+                lambda j: 1 if cond.is_succeeded(j.status) else None,
+                namespace=NAMESPACE))
+            if succeeded >= jobs and injected[0] >= disruptions:
+                break
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"{succeeded}/{jobs} jobs Succeeded, "
+                    f"{injected[0]}/{disruptions} disruptions after "
+                    f"{timeout}s")
+            time.sleep(0.02)
+        convergence = time.perf_counter() - t0
+    finally:
+        disruptor_stop.set()
+        kubelet.stop()
+        controller.stop()
+        ckpt.stop()
+        store.stop_watchers()
+
+    goodputs = [metrics.job_goodput_ratio.value(
+        job_namespace=NAMESPACE, job=f"bench-{i:04d}")
+        for i in range(jobs)]
+    goodputs = [g for g in goodputs if g > 0.0]
+    lost_total = (metrics.steps_lost_per_disruption.sum_value(
+        job_namespace=NAMESPACE) - lost_sum_before)
+    lost_n = (metrics.steps_lost_per_disruption.count_value(
+        job_namespace=NAMESPACE) - lost_n_before)
+    restored = [r.status.restored_from_step
+                for r in store.list(store_mod.CHECKPOINTRECORDS,
+                                    namespace=NAMESPACE)
+                if r.status.restored_from_step is not None]
+    durations = timer.snapshot()
+    return {
+        "convergence_seconds": round(convergence, 3),
+        "jobs_per_sec": round(jobs / convergence, 2),
+        "syncs": len(durations),
+        "reconcile_p50_ms": round(_percentile(durations, 0.50) * 1e3, 3),
+        "reconcile_p99_ms": round(_percentile(durations, 0.99) * 1e3, 3),
+        "jobs": jobs,
+        "workers_per_job": workers,
+        "pods": jobs * workers,
+        "threadiness": threadiness,
+        "steps_per_job": steps,
+        "save_interval_steps": save_interval,
+        "disruptions": disruptions,
+        "disruptions_injected": injected[0],
+        "barriers_acked": int(metrics.checkpoint_barriers.value(
+            job_namespace=NAMESPACE, outcome="acked") - acked_before),
+        "barriers_timeout": int(metrics.checkpoint_barriers.value(
+            job_namespace=NAMESPACE, outcome="timeout")
+            - timeout_before),
+        "steps_lost_total": int(lost_total),
+        "steps_lost_per_disruption_mean": round(
+            lost_total / lost_n, 2) if lost_n else 0.0,
+        "goodput_ratio_mean": round(
+            sum(goodputs) / len(goodputs), 4) if goodputs else None,
+        "goodput_ratio_min": round(min(goodputs), 4) if goodputs else None,
+        "restores_observed": len(restored),
+    }
+
+
 def _environment() -> Dict:
     """Environment fingerprint fields (auditable round-over-round):
     jax version + platform/chip kind when jax is importable, host facts
@@ -446,6 +736,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--chips-per-job", type=int, default=4,
                    help="(--tenants) slice size per job = per-queue "
                         "nominal quota")
+    p.add_argument("--disruptions", type=int, default=0,
+                   help="N>0 switches to the disruption/goodput "
+                        "scenario: checkpointing fake jobs with N "
+                        "injected drains through the save-before-evict "
+                        "barrier (controller/ckpt.py); barrier "
+                        "outcomes, steps-lost, and goodput ratio in "
+                        "the artifact")
+    p.add_argument("--steps", type=int, default=80,
+                   help="(--disruptions) fake training steps per job")
+    p.add_argument("--save-interval", type=int, default=20,
+                   help="(--disruptions) periodic-save cadence in steps")
     args = p.parse_args(argv)
 
     config = {"jobs": args.jobs, "workers": args.workers,
@@ -456,6 +757,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                        "chips_per_job": args.chips_per_job})
         metric = (f"controlplane_tenant_convergence_jobs_per_sec"
                   f"[{args.tenants}t x {args.jobs}x{args.workers}]")
+    elif args.disruptions > 0:
+        config.update({"disruptions": args.disruptions,
+                       "steps": args.steps,
+                       "save_interval": args.save_interval})
+        metric = (f"controlplane_disruption_goodput_ratio"
+                  f"[{args.jobs}x{args.workers} d{args.disruptions}]")
     else:
         metric = (f"controlplane_convergence_jobs_per_sec"
                   f"[{args.jobs}x{args.workers}]")
@@ -465,14 +772,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                 args.tenants, args.jobs, args.workers, args.threadiness,
                 args.timeout, chips_per_job=args.chips_per_job,
                 kubelet_tick=args.kubelet_tick)
+        elif args.disruptions > 0:
+            result = run_disruption_bench(
+                args.jobs, args.workers, args.threadiness, args.timeout,
+                disruptions=args.disruptions, steps=args.steps,
+                save_interval=args.save_interval,
+                kubelet_tick=args.kubelet_tick)
         else:
             result = run_bench(args.jobs, args.workers, args.threadiness,
                                args.timeout,
                                kubelet_tick=args.kubelet_tick)
+        if args.disruptions > 0:
+            value, unit = result.get("goodput_ratio_mean"), "ratio"
+        else:
+            value, unit = result["jobs_per_sec"], "jobs/sec"
         print(json.dumps({
             "metric": metric,
-            "value": result["jobs_per_sec"],
-            "unit": "jobs/sec",
+            "value": value,
+            "unit": unit,
             **result,
             "env": _environment(),
             "config_fingerprint": config_fingerprint(config),

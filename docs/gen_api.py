@@ -22,7 +22,8 @@ schema at `manifests/base/tpujob.schema.json`. Semantic rules beyond
 types (required containers, replica bounds, name formats) live in
 `tf_operator_tpu/api/validation.py`. The TenantQueue/ClusterQueue
 quota kinds (cohort semantics, borrowing, reclaim) are documented in
-`docs/quota.md`.
+`docs/quota.md`; the CheckpointRecord kind (the save-before-evict
+barrier's ack channel) in `docs/checkpoint.md`.
 """
 
 
@@ -41,7 +42,11 @@ def _fmt_type(prop: dict) -> str:
 
 
 def render() -> str:
-    from tf_operator_tpu.api.types import ClusterQueue, TenantQueue
+    from tf_operator_tpu.api.types import (
+        CheckpointRecord,
+        ClusterQueue,
+        TenantQueue,
+    )
 
     lines = [HEADER]
     emitted = set()
@@ -57,8 +62,9 @@ def render() -> str:
             lines.append(f"| `{field}` | {_fmt_type(prop)} |")
 
     # TPUJob first (the headline kind), then the tenant-queue admission
-    # kinds; shared $defs (ObjectMeta etc.) are emitted once.
-    for cls in (None, TenantQueue, ClusterQueue):
+    # kinds and the checkpoint-coordination record; shared $defs
+    # (ObjectMeta etc.) are emitted once.
+    for cls in (None, TenantQueue, ClusterQueue, CheckpointRecord):
         schema = generate_schema(cls)
         emit(schema["title"], schema)
         for name, obj in schema.get("$defs", {}).items():

@@ -92,6 +92,41 @@ def test_tenant_scenario_smoke_and_artifact_schema(capsys):
             > 0)
 
 
+def test_disruption_scenario_smoke_and_artifact_schema(capsys):
+    """--disruptions N goodput scenario: checkpointing fake jobs with
+    injected drains through the save-before-evict barrier. Every
+    disruption must resolve (acked or timed out), and because the fake
+    kubelet acks barriers promptly, no steps may be lost — goodput
+    stays 1.0 in smoke."""
+    rc = bench_controlplane.main(["--jobs", "3", "--workers", "2",
+                                  "--disruptions", "2", "--steps", "30",
+                                  "--save-interval", "5",
+                                  "--timeout", "90"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "artifact must be exactly one line"
+    artifact = json.loads(out[0])
+    assert artifact["metric"].startswith(
+        "controlplane_disruption_goodput_ratio")
+    assert artifact["unit"] == "ratio"
+    assert artifact["value"] == artifact["goodput_ratio_mean"]
+    assert artifact["disruptions"] == 2
+    assert artifact["disruptions_injected"] == 2
+    # Every injected disruption resolved through the barrier.
+    assert (artifact["barriers_acked"] + artifact["barriers_timeout"]
+            == 2)
+    assert {"steps_lost_total", "steps_lost_per_disruption_mean",
+            "goodput_ratio_mean", "goodput_ratio_min",
+            "restores_observed", "steps_per_job",
+            "save_interval_steps"} <= set(artifact)
+    # Prompt acks in the fake kubelet: save-before-evict preserves all
+    # progress, so the goodput ratio is exactly 1.0.
+    assert artifact["barriers_acked"] == 2
+    assert artifact["steps_lost_total"] == 0
+    assert artifact["goodput_ratio_mean"] == 1.0
+    assert ENV_KEYS <= set(artifact["env"])
+
+
 def test_failure_still_emits_one_json_line(capsys):
     # Impossible timeout: the artifact contract holds on failure too.
     rc = bench_controlplane.main(["--jobs", "2", "--workers", "1",

@@ -101,6 +101,24 @@ class TestTranslation:
         assert back.spec.node_name == "node-1"
         assert back.metadata.labels == pod.metadata.labels
 
+    def test_pod_toleration_round_trip(self):
+        from tf_operator_tpu.api.types import Toleration
+
+        pod = Pod(spec=PodSpec(
+            containers=[Container()],
+            tolerations=[Toleration(key="google.com/tpu",
+                                    operator="Exists"),
+                         Toleration(key="dedicated", operator="Equal",
+                                    value="ml", effect="NoSchedule",
+                                    toleration_seconds=60)]))
+        k = pod_to_k8s(pod)
+        assert k["spec"]["tolerations"] == [
+            {"key": "google.com/tpu", "operator": "Exists"},
+            {"key": "dedicated", "operator": "Equal", "value": "ml",
+             "effect": "NoSchedule", "tolerationSeconds": 60}]
+        back = pod_from_k8s(k)
+        assert back.spec.tolerations == pod.spec.tolerations
+
     def test_pod_exitcode_restart_policy_maps_to_never(self):
         pod = Pod(spec=PodSpec(containers=[Container()],
                                restart_policy=RestartPolicy.EXIT_CODE))
@@ -253,6 +271,67 @@ users:
         with open(cfg.ca_file, "rb") as f:
             assert f.read() == b"fake-ca"
         os.unlink(cfg.ca_file)
+
+
+class TestRbacEnforcement:
+    """The fake apiserver enforces manifests/base/rbac.yaml (VERDICT
+    round-5 #6): any operator request outside the deployed ClusterRole's
+    verbs answers 403, so manifest/RBAC drift fails hermetic e2e instead
+    of surfacing on a real cluster."""
+
+    def test_rules_loaded_by_default(self, fake):
+        rules = fake.state.rbac_rules
+        assert rules, "checked-in ClusterRole must load by default"
+        assert "create" in rules[("", "pods")]
+        assert "patch" in rules[(constants.GROUP, constants.PLURAL
+                                 + "/status")]
+
+    def test_ungranted_verb_403s(self, client, fake):
+        # The role grants nodes get/list/watch/patch — never delete
+        # (the operator cordons, it does not remove cluster nodes).
+        fake.state.add_node("doomed")
+        from tf_operator_tpu.runtime.kube import KubeApiError
+
+        with pytest.raises(KubeApiError) as exc:
+            client.delete(store_mod.NODES, "", "doomed")
+        assert exc.value.code == 403
+        # The 403 names the missing grant, for drift debuggability.
+        assert "delete" in str(exc.value) and "nodes" in str(exc.value)
+        # The node survived the denied request.
+        assert client.get(store_mod.NODES, "", "doomed")
+
+    def test_tightened_role_fails_write_paths(self, tmp_path):
+        # A role missing the pods create verb (the drift this guards
+        # against: someone trims rbac.yaml without knowing the
+        # controller creates pods) 403s the controller's write.
+        role = tmp_path / "rbac.yaml"
+        role.write_text("""\
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRole
+metadata: {name: tpu-operator}
+rules:
+  - apiGroups: [""]
+    resources: ["pods"]
+    verbs: ["get", "list", "watch"]
+""")
+        from tf_operator_tpu.runtime.kube import KubeApiError
+
+        with FakeKubeApiServer(rbac_path=str(role)) as server:
+            c = KubeClient(KubeConfig(server=server.url))
+            assert c.list(store_mod.PODS, "default")["items"] == []
+            with pytest.raises(KubeApiError) as exc:
+                c.create(store_mod.PODS, "default", pod_to_k8s(
+                    Pod(metadata=ObjectMeta(name="px"),
+                        spec=PodSpec(containers=[Container()]))))
+            assert exc.value.code == 403
+
+    def test_permissive_without_rules(self):
+        with FakeKubeApiServer(rbac_path=None) as server:
+            c = KubeClient(KubeConfig(server=server.url))
+            fake_node = c.request("POST", "/api/v1/nodes",
+                                  body={"apiVersion": "v1", "kind": "Node",
+                                        "metadata": {"name": "n1"}})
+            assert fake_node["metadata"]["name"] == "n1"
 
 
 # ---------------------------------------------------------------------------

@@ -135,7 +135,10 @@ def test_rbac_manifest_parses_and_covers_runtime_verbs():
     assert "patch" in rules[("tpu-operator.dev", "tpujobs/status")]
     assert {"get", "create", "update"} <= rules[
         ("coordination.k8s.io", "leases")]
-    assert "create" in rules[("", "events")]
+    # Recorder posts + aggregates; the SDK reads them back in e2e.
+    assert {"create", "patch", "list"} <= rules[("", "events")]
+    # SDK log reads go through the apiserver's kubelet-log proxy.
+    assert "get" in rules[("", "pods/log")]
     # KubePdbControl.sync PATCHes minAvailable on gang-threshold change.
     assert {"create", "delete", "patch"} <= rules[
         ("policy", "poddisruptionbudgets")]

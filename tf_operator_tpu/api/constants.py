@@ -72,5 +72,34 @@ LABEL_ICI_DOMAIN = "tpu-operator.dev/ici-domain"
 LABEL_GKE_NODEPOOL = "cloud.google.com/gke-nodepool"
 
 # The extended-resource name TPU device plugins advertise on nodes and
-# pods request chips under (GKE convention).
+# pods request chips under (GKE convention). Doubles as the taint key
+# GKE TPU nodepools carry — gang worker pods get a matching toleration
+# stamped at create time (tpu_controller.set_cluster_spec) so the
+# nodepool taint manager doesn't evict what the binder placed.
 RESOURCE_TPU = "google.com/tpu"
+
+# Checkpoint coordination (controller/ckpt.py). The preemption notice is
+# stamped on a gang's pods when a planned disruption (drain / quota
+# reclaim) requests a save-before-evict barrier; value is JSON
+# {"barrier": id, "deadline": RFC3339, "reason": str}. The data plane
+# forwards it to the worker process as a file (env below), the training
+# loop forces a final save and acks through its CheckpointRecord.
+ANNOTATION_PREEMPT_NOTICE = "tpu-operator.dev/preemption-notice"
+
+# Env the data plane gives every pod it spawns: where the preemption
+# notice will appear, and where the worker publishes its checkpoint
+# state (saves / barrier acks / restore confirmation) for the plane to
+# mirror into its CheckpointRecord.
+ENV_PREEMPT_FILE = "TPUJOB_PREEMPT_FILE"
+ENV_CKPT_FILE = "TPUJOB_CKPT_FILE"
+
+# Env the controller renders from the job's CheckpointPolicy at pod
+# create time (tpu_controller.set_cluster_spec). TPUJOB_RESTORE_STEP is
+# only present when a committed checkpoint exists — restart-with-identity
+# resumes where the barrier saved. None of these enter the bootstrap
+# hash: a new checkpoint must not restart live pods.
+ENV_CKPT_DIR = "TPUJOB_CKPT_DIR"
+ENV_CKPT_INTERVAL_STEPS = "TPUJOB_CKPT_INTERVAL_STEPS"
+ENV_CKPT_INTERVAL_SECONDS = "TPUJOB_CKPT_INTERVAL_SECONDS"
+ENV_CKPT_MAX_TO_KEEP = "TPUJOB_CKPT_MAX_TO_KEEP"
+ENV_RESTORE_STEP = "TPUJOB_RESTORE_STEP"

@@ -99,6 +99,12 @@ class JobConditionType:
     # False on admission; no reference analog (the reference had no
     # admission control of its own).
     QUEUED = "Queued"
+    # TPU extension (controller/ckpt.py): a save-before-evict barrier is
+    # in flight for this job's gang — a planned disruption (drain or
+    # quota reclaim) is waiting for the final checkpoint acks before
+    # evicting. Flips to status False on full-gang ack or barrier
+    # timeout. No reference analog.
+    CHECKPOINT_BARRIER = "CheckpointBarrier"
     RUNNING = "Running"
     RESTARTING = "Restarting"
     SUCCEEDED = "Succeeded"
@@ -180,11 +186,32 @@ class Container(ApiObject):
 
 
 @dataclasses.dataclass
+class Toleration(ApiObject):
+    """core/v1 Toleration subset the binder/taint machinery needs.
+
+    Immutable after pod creation (K8s semantics), so the controller
+    stamps it at CREATE time — on GKE a bound TPU pod without the
+    ``google.com/tpu`` toleration is evicted by the nodepool taint
+    manager even though the binder placed it correctly."""
+
+    key: str = ""
+    operator: str = "Exists"       # Exists|Equal
+    value: str = ""
+    effect: str = ""               # ""=all, NoSchedule|NoExecute|...
+    toleration_seconds: Optional[int] = None
+
+
+@dataclasses.dataclass
 class PodSpec(ApiObject):
     containers: List[Container] = field(default_factory=list)
     restart_policy: str = RestartPolicy.NEVER
     scheduler_name: str = ""
     node_selector: Dict[str, str] = field(default_factory=dict)
+    # Taints this pod tolerates (core/v1). Gang worker pods get the
+    # google.com/tpu toleration stamped at create time
+    # (tpu_controller.set_cluster_spec) — GKE TPU nodepools taint their
+    # nodes with the resource name.
+    tolerations: List[Toleration] = field(default_factory=list)
     # Which node agent runs this pod. Empty = unscheduled; agents claim
     # pending pods by CAS-ing their own name in (pull scheduling — the
     # kube-scheduler binding analog for the served control plane).
@@ -329,6 +356,39 @@ class HealthPolicy(ApiObject):
 
 
 @dataclasses.dataclass
+class CheckpointPolicy(ApiObject):
+    """Checkpoint-coordination knobs (controller/ckpt.py).
+
+    No reference analog: the reference delegated checkpoints entirely to
+    user containers (SURVEY §5), so a drain or quota reclaim threw away
+    every step since the user's last periodic save. With this policy the
+    control plane turns every PLANNED disruption into a save-then-evict
+    barrier and every rebind into a restore (docs/checkpoint.md).
+
+    enabled:                 opt this job into coordinated checkpoints.
+    directory:               checkpoint root the training loop saves to /
+                             restores from (rendered into pod env as
+                             TPUJOB_CKPT_DIR).
+    interval_steps:          periodic-save cadence in optimizer steps
+                             (None = no step-based cadence).
+    interval_seconds:        periodic-save cadence in wall seconds
+                             (None = no time-based cadence).
+    max_to_keep:             retained checkpoints (Checkpointer GC).
+    barrier_timeout_seconds: how long a drain/reclaim waits for the
+                             full-gang save ack before evicting anyway —
+                             the barrier bounds eviction latency, never
+                             blocks it forever.
+    """
+
+    enabled: bool = False
+    directory: str = ""
+    interval_steps: Optional[int] = None
+    interval_seconds: Optional[float] = None
+    max_to_keep: int = 3
+    barrier_timeout_seconds: float = 30.0
+
+
+@dataclasses.dataclass
 class RunPolicy(ApiObject):
     """Reference common/v1/types.go:107-148."""
 
@@ -339,6 +399,9 @@ class RunPolicy(ApiObject):
     scheduling_policy: Optional[SchedulingPolicy] = None
     # TPU extension: maintenance-aware slice health (controller/health.py).
     health_policy: Optional[HealthPolicy] = None
+    # TPU extension: save-before-evict barriers + restore-with-identity
+    # (controller/ckpt.py).
+    checkpoint_policy: Optional[CheckpointPolicy] = None
 
 
 @dataclasses.dataclass
@@ -417,6 +480,13 @@ class JobStatus(ApiObject):
     # replica first became Running/Succeeded — the latch behind the
     # pod-to-AllReplicasReady latency metric (BASELINE north star).
     all_replicas_ready_time: Optional[_dt.datetime] = None
+    # Checkpoint coordination (controller/ckpt.py): the newest step every
+    # checkpointing replica has durably saved (the committed step a
+    # rebind restores from), and the step the CURRENT incarnation
+    # actually restored from after the last disruption. None until the
+    # first save / first restore.
+    last_checkpoint_step: Optional[int] = None
+    restored_from_step: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -558,6 +628,44 @@ class ClusterQueue(ApiObject):
         namespace=""))
     spec: ClusterQueueSpec = field(default_factory=ClusterQueueSpec)
     status: ClusterQueueStatus = field(default_factory=ClusterQueueStatus)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointRecord: one replica's durable-checkpoint state, reported by
+# the data plane (controller/ckpt.py). The record is the ack channel of
+# the save-before-evict barrier: the training loop publishes each save
+# (and each barrier ack) through its node's data plane, the coordinator
+# reads the gang's records to decide when eviction may proceed and what
+# step a rebind restores from. Named after the pod, labeled job-name so
+# the store's label index serves per-job listing. No reference analog.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class CheckpointRecordStatus(ApiObject):
+    # Newest step this replica has DURABLY saved (-1 = none yet).
+    step: int = -1
+    # Newest step the replica reported reaching (>= step); the
+    # steps-lost-per-disruption accounting reads progress - committed.
+    progress_step: int = -1
+    # Barrier id this record acks: set when the save was forced by a
+    # preemption notice (controller/ckpt.py stamps the id on the pod).
+    barrier_id: str = ""
+    # Where the checkpoint landed (the restore dir a rebind receives).
+    directory: str = ""
+    # Wall seconds the last save took (checkpoint_save_seconds metric).
+    save_seconds: float = 0.0
+    # Step this incarnation restored from at startup (None = cold start).
+    restored_from_step: Optional[int] = None
+    updated_at: Optional[_dt.datetime] = None
+
+
+@dataclasses.dataclass
+class CheckpointRecord(ApiObject):
+    api_version: str = constants.API_VERSION
+    kind: str = "CheckpointRecord"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: CheckpointRecordStatus = field(
+        default_factory=CheckpointRecordStatus)
 
 
 # ---------------------------------------------------------------------------

@@ -102,6 +102,26 @@ def _spec_errors(spec: TPUJobSpec):
     if ttl is not None and ttl < 0:
         yield "spec.runPolicy.ttlSecondsAfterFinished must be >= 0"
 
+    cp = spec.run_policy.checkpoint_policy
+    if cp is not None:
+        if cp.enabled and not cp.directory:
+            # Without a directory there is nowhere to save to or restore
+            # from — an enabled policy would silently never checkpoint.
+            yield ("spec.runPolicy.checkpointPolicy.directory is required "
+                   "when the policy is enabled")
+        if cp.interval_steps is not None and cp.interval_steps < 1:
+            yield "spec.runPolicy.checkpointPolicy.intervalSteps must be >= 1"
+        if cp.interval_seconds is not None and cp.interval_seconds <= 0:
+            yield ("spec.runPolicy.checkpointPolicy.intervalSeconds must "
+                   "be > 0")
+        if cp.max_to_keep < 1:
+            yield "spec.runPolicy.checkpointPolicy.maxToKeep must be >= 1"
+        if cp.barrier_timeout_seconds <= 0:
+            # A zero/negative timeout would make every barrier complete
+            # instantly (defeating the save) or hang semantics unclear.
+            yield ("spec.runPolicy.checkpointPolicy.barrierTimeoutSeconds "
+                   "must be > 0")
+
     if spec.queue_name and not _NAME_RE.match(spec.queue_name):
         yield (f"spec.queueName {spec.queue_name!r} must be a lowercase "
                "RFC-1123 label (alphanumerics and '-')")

@@ -126,6 +126,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "ClusterQueues carry nominal chip quotas, cohort "
                         "borrowing, and reclaim (docs/quota.md). Off = "
                         "admission behavior identical to today")
+    p.add_argument("--enable-ckpt-coordination", action="store_true",
+                   help="run the CheckpointCoordinator: planned "
+                        "disruptions (slice-health drains, quota "
+                        "reclaims) of jobs whose runPolicy."
+                        "checkpointPolicy opts in become save-then-"
+                        "evict barriers, and rebinds restore from the "
+                        "barrier-committed step (docs/checkpoint.md). "
+                        "Off = eviction behavior identical to today")
     p.add_argument("--queue-config", default=None,
                    help="YAML/JSON file declaring clusterQueues / "
                         "tenantQueues to seed at startup (see "
@@ -147,12 +155,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "invisible to gang admission (docs/health.md)")
     p.add_argument("--enable-slice-health", dest="slice_health",
                    default=True, action=argparse.BooleanOptionalAction,
-                   help="(kube backend, with the gang binder) run the "
-                        "slice-health controller: cordon nodes on "
-                        "maintenance/preemption notices and, for jobs "
-                        "whose runPolicy.healthPolicy opts in, "
+                   help="run the slice-health controller: cordon nodes "
+                        "on maintenance/preemption notices and, for "
+                        "jobs whose runPolicy.healthPolicy opts in, "
                         "atomically drain affected gangs and rebind "
-                        "them on spare capacity (docs/health.md)")
+                        "them on spare capacity (docs/health.md). "
+                        "Takes effect on the kube backend with the "
+                        "gang binder, and on the local/served backends "
+                        "with --enable-gang-scheduling")
     p.add_argument("--health-drain-grace-seconds", type=float,
                    default=0.0,
                    help="operator-wide default for the observed-"
@@ -246,7 +256,9 @@ class Server:
         tenant_kwargs = dict(
             enable_tenant_queues=getattr(args, "enable_tenant_queues",
                                          False),
-            queue_config=getattr(args, "queue_config", None))
+            queue_config=getattr(args, "queue_config", None),
+            enable_ckpt_coordination=getattr(
+                args, "enable_ckpt_coordination", False))
         if getattr(args, "backend", "local") == "kube":
             # Cluster mode: the Store is the informer cache inside
             # KubeOperator; reads/writes/leases go to the K8s API.
@@ -286,6 +298,15 @@ class Server:
             self.operator = Operator(
                 store=self.store,
                 namespace=args.namespace or None,
+                # Slice health needs gang displace/readmit to repair, so
+                # the default-on flag only takes effect alongside gang
+                # scheduling on the process-native backends (the kube
+                # backend gates it on the binder the same way).
+                enable_slice_health=(
+                    getattr(args, "slice_health", True)
+                    and args.enable_gang_scheduling),
+                health_drain_grace_seconds=getattr(
+                    args, "health_drain_grace_seconds", 0.0),
                 **gang_kwargs, **tenant_kwargs, **op_kwargs)
         self.api_server = None
         if getattr(args, "api_port", 0) != 0:
@@ -439,6 +460,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.queue_config and not args.enable_tenant_queues:
         parser.error("--queue-config only makes sense with "
                      "--enable-tenant-queues")
+    if args.enable_ckpt_coordination and args.backend == "kube":
+        parser.error("--enable-ckpt-coordination is not yet supported "
+                     "with --backend kube (kubelet cannot relay the "
+                     "preemption-notice/ack files; needs the sidecar "
+                     "relay recorded in ROADMAP.md); use the local or "
+                     "served backend")
     if args.backend == "kube" and args.api_port != 0:
         parser.error("--backend kube cannot serve --api-port: the Store "
                      "is a read cache of the cluster there, so jobs "

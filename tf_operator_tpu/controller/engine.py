@@ -178,7 +178,8 @@ class JobEngine:
                  workqueue: Optional[RateLimitingQueue] = None,
                  expectations: Optional[ControllerExpectations] = None,
                  gang: Optional[GangScheduler] = None,
-                 config: Optional[EngineConfig] = None):
+                 config: Optional[EngineConfig] = None,
+                 ckpt=None):
         self.plugin = plugin
         self.pod_control = pod_control
         self.endpoint_control = endpoint_control
@@ -187,6 +188,12 @@ class JobEngine:
         self.expectations = expectations or ControllerExpectations()
         self.gang = gang
         self.config = config or EngineConfig()
+        # Optional checkpoint coordinator (controller/ckpt.py): each
+        # sync rolls the save-before-evict barrier arc into the job's
+        # CheckpointBarrier condition and mirrors lastCheckpointStep /
+        # restoredFromStep onto the status. None = no checkpoint fields
+        # ever touched.
+        self.ckpt = ckpt
 
     # ------------------------------------------------------------------
     # Master reconcile (reference common/job.go:124-343)
@@ -311,6 +318,16 @@ class JobEngine:
                     f"drained ({displaced}); replicas will rebind on "
                     "spare capacity and resume from the latest "
                     "checkpoint")
+
+        # Checkpoint-coordination arc (controller/ckpt.py): surface an
+        # in-flight save-before-evict barrier as a CheckpointBarrier
+        # condition (resolved to False on full-gang ack or timeout) and
+        # mirror the committed/restored steps onto the status. Level-
+        # triggered and quiet like the displaced/quota arcs above: the
+        # condition machinery no-ops on re-assert and the change diff
+        # below decides whether anything is written.
+        if self.ckpt is not None:
+            self.ckpt.sync_job_status(job)
 
         for rtype, spec in replica_specs.items():
             self.reconcile_pods(job, pods, rtype, spec, replica_specs)

@@ -131,7 +131,8 @@ class SliceGangScheduler(GangScheduler):
                  capacity_provider=None,
                  domain_capacity_provider=None,
                  draining_provider=None,
-                 quota=None):
+                 quota=None,
+                 ckpt=None):
         if fairness not in ("backfill", "strict", "aged"):
             raise ValueError(f"unknown gang fairness {fairness!r}")
         self.store = store
@@ -164,6 +165,12 @@ class SliceGangScheduler(GangScheduler):
         self.quota = quota
         if quota is not None and getattr(quota, "priority_of", None):
             quota.priority_of = self._priority_of
+        # Optional checkpoint coordinator (controller/ckpt.py): displace
+        # becomes a save-then-evict barrier for jobs whose
+        # checkpointPolicy opts in — the displacement is deferred until
+        # the gang acked a final save or the barrier timed out. None =
+        # pre-coordinator eviction, byte-identical.
+        self.ckpt = ckpt
         self.fairness = fairness
         self.aging_seconds = aging_seconds
         self.priority_classes = dict(priority_classes or {})
@@ -306,6 +313,16 @@ class SliceGangScheduler(GangScheduler):
         group = self.store.try_get(store_mod.SLICEGROUPS, namespace, name)
         if group is None or group.status.phase == PHASE_PENDING:
             return False
+        if self.ckpt is not None and not self.ckpt.ready_to_evict(
+                namespace, name, reason):
+            # Save-before-evict barrier in flight (controller/ckpt.py):
+            # hold the displacement; the caller's level-triggered pass
+            # (quota reclaim re-derived per _admit, health retry per
+            # health_pass) retries, and an ack landing mid-barrier pokes
+            # readmit so release happens promptly. The barrier timeout
+            # bounds the wait — a reclaim or drain can never hang on a
+            # wedged worker.
+            return False
         group.status.phase = PHASE_PENDING
         group.status.pending_since = _now()
         group.status.displaced_reason = reason
@@ -313,6 +330,10 @@ class SliceGangScheduler(GangScheduler):
             self.store.update_status(store_mod.SLICEGROUPS, group)
         except (store_mod.ConflictError, store_mod.NotFoundError):
             return False  # racing sync; the next health pass retries
+        if self.ckpt is not None:
+            # Displacement landed: close the barrier episode (a future
+            # disruption opens a fresh one).
+            self.ckpt.release(namespace, name)
         log.info("displaced slice group %s/%s (%s); re-entering "
                  "admission at original priority", namespace, name,
                  reason)

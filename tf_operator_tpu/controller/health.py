@@ -155,12 +155,18 @@ class SliceHealthController:
                  pod_control=None, recorder=None,
                  namespace: Optional[str] = None,
                  default_grace_seconds: float = 0.0,
-                 resync_seconds: float = 1.0):
+                 resync_seconds: float = 1.0,
+                 ckpt=None):
         self.store = store
         self.client = client
         self.gang = gang
         self.pod_control = pod_control
         self.recorder = recorder
+        # Optional checkpoint coordinator (controller/ckpt.py): a drain
+        # of a checkpointPolicy-enabled gang becomes save-then-evict —
+        # the eviction waits (bounded by barrierTimeoutSeconds) for the
+        # gang's final save acks. None = pre-coordinator drains.
+        self.ckpt = ckpt
         self.namespace = namespace
         self.default_grace_seconds = default_grace_seconds
         self.resync_seconds = resync_seconds
@@ -293,6 +299,14 @@ class SliceHealthController:
                                  f"Gang {name} runs on degraded node(s) "
                                  f"({', '.join(reasons)}); draining in "
                                  f"{grace:.0f}s unless they recover")
+                continue
+            if self.ckpt is not None and not self.ckpt.ready_to_evict(
+                    ns, name, f"node degraded ({', '.join(reasons)})"):
+                # Save-before-evict barrier in flight: the gang is
+                # writing its final checkpoint. Hold the eviction; the
+                # next health pass (resync tick) re-consults, and the
+                # barrier timeout guarantees the drain can never hang
+                # behind a wedged worker.
                 continue
             self._drain(ns, name, job, bad_pods, reasons)
 

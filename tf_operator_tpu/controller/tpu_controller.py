@@ -124,12 +124,17 @@ class TPUJobController(JobPlugin):
                  recorder: Optional[Recorder] = None,
                  config: Optional[EngineConfig] = None,
                  gang=None,
-                 namespace: Optional[str] = None):
+                 namespace: Optional[str] = None,
+                 ckpt=None):
         self.store = store
         self.recorder = recorder or Recorder()
         self.namespace = namespace  # None = all namespaces
         self.workqueue = RateLimitingQueue()
         self.expectations = ControllerExpectations()
+        # Optional checkpoint coordinator (controller/ckpt.py): renders
+        # restore-with-identity env into created pods and rolls the
+        # barrier arc into job status (via the engine hook).
+        self.ckpt = ckpt
         self.engine = JobEngine(
             plugin=self,
             pod_control=StorePodControl(store, self.recorder),
@@ -139,6 +144,7 @@ class TPUJobController(JobPlugin):
             expectations=self.expectations,
             gang=gang,
             config=config,
+            ckpt=ckpt,
         )
         if gang is not None and getattr(gang, "pod_control", None) is None:
             # Preemption evicts victim pods through the same control the
@@ -220,7 +226,7 @@ class TPUJobController(JobPlugin):
         this used to be three full-namespace list() scans (deepcopying
         every object in the namespace) per deleted job."""
         for kind in (store_mod.PODS, store_mod.ENDPOINTS,
-                     store_mod.SLICEGROUPS):
+                     store_mod.SLICEGROUPS, store_mod.CHECKPOINTRECORDS):
             for ns, name in self.store.owned_keys(kind, job.metadata.uid):
                 self.store.try_delete(kind, ns, name)
 
@@ -538,6 +544,27 @@ class TPUJobController(JobPlugin):
                                      max(1, job.spec.slice.num_slices))
             container.resources[constants.RESOURCE_TPU] = str(
                 topo.devices_per_host)
+        if (job.spec.slice.accelerator
+                and rtype.lower() == ReplicaType.WORKER
+                and not any(t.key == constants.RESOURCE_TPU
+                            for t in pod.spec.tolerations)):
+            # GKE TPU nodepools taint their nodes with the extended-
+            # resource key; without a matching toleration the taint
+            # manager evicts a bound worker pod even though the binder
+            # placed it correctly. Tolerations are immutable after
+            # creation, so this is stamped here, not at bind time.
+            from tf_operator_tpu.api.types import Toleration
+
+            pod.spec.tolerations.append(Toleration(
+                key=constants.RESOURCE_TPU, operator="Exists"))
+        # Restore-with-identity (controller/ckpt.py): checkpoint policy
+        # knobs + the committed restore step, rendered at create time.
+        # Deliberately AFTER bootstrap env and OUTSIDE the bootstrap
+        # hash (computed from render_worker_env alone): a new committed
+        # checkpoint must not read as a topology change and restart
+        # live pods.
+        if self.ckpt is not None:
+            container.env.update(self.ckpt.bootstrap_env(job))
 
     def bootstrap_hash(self, job: TPUJob, rtype: str, index: int) -> str:
         """Cached world digest: the env render + sha1 is a pure function

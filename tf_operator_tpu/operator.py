@@ -46,16 +46,33 @@ class Operator:
                  gang_queue_quotas: Optional[dict] = None,
                  gang_preemption: bool = False,
                  enable_tenant_queues: bool = False,
-                 queue_config: Optional[str] = None):
+                 queue_config: Optional[str] = None,
+                 enable_ckpt_coordination: bool = False,
+                 enable_slice_health: bool = False,
+                 health_drain_grace_seconds: float = 0.0):
         self.store = store or Store()
         self.recorder = Recorder(sink=self._persist_event)
         config = config or EngineConfig()
         gang = None
         self.quota = None
+        self.ckpt = None
+        self.health = None
         if enable_tenant_queues and not enable_gang_scheduling:
             raise ValueError("tenant queues sit above gang admission: "
                              "--enable-tenant-queues requires "
                              "--enable-gang-scheduling")
+        if enable_slice_health and not enable_gang_scheduling:
+            raise ValueError("slice health drains whole gangs: "
+                             "--enable-slice-health requires "
+                             "--enable-gang-scheduling")
+        if enable_ckpt_coordination:
+            from tf_operator_tpu.controller.ckpt import (
+                CheckpointCoordinator,
+            )
+
+            self.ckpt = CheckpointCoordinator(self.store,
+                                              recorder=self.recorder,
+                                              namespace=namespace)
         if enable_gang_scheduling:
             config.enable_gang_scheduling = True
             if enable_tenant_queues:
@@ -75,10 +92,27 @@ class Operator:
                                       priority_classes=gang_priority_classes,
                                       queue_quotas=gang_queue_quotas,
                                       preemption=gang_preemption,
-                                      quota=self.quota)
+                                      quota=self.quota,
+                                      ckpt=self.ckpt)
         self.controller = TPUJobController(self.store, recorder=self.recorder,
                                            config=config, gang=gang,
-                                           namespace=namespace)
+                                           namespace=namespace,
+                                           ckpt=self.ckpt)
+        if self.ckpt is not None and gang is not None:
+            # A barrier ack landing between resyncs must release the
+            # held eviction promptly: record writes poke admission.
+            self.ckpt.on_ack = gang.readmit
+        if enable_slice_health:
+            from tf_operator_tpu.controller.health import (
+                SliceHealthController,
+            )
+
+            self.health = SliceHealthController(
+                self.store, gang=gang,
+                pod_control=self.controller.engine.pod_control,
+                recorder=self.recorder, namespace=namespace,
+                default_grace_seconds=health_drain_grace_seconds,
+                ckpt=self.ckpt)
         self.backend = (LocalProcessBackend(self.store)
                         if backend is _DEFAULT_BACKEND else backend)
         if gang is not None and hasattr(self.backend,
@@ -90,9 +124,13 @@ class Operator:
             self.backend.on_gang_drained = gang.readmit
 
     def start(self, threadiness: int = 2) -> None:
+        if self.ckpt is not None:
+            self.ckpt.start()
         if self.backend is not None:
             self.backend.start()
         self.controller.run(threadiness=threadiness)
+        if self.health is not None:
+            self.health.start()
         log.info("operator started (threadiness=%d)", threadiness)
 
     def _persist_event(self, ev) -> None:
@@ -121,9 +159,13 @@ class Operator:
             log.debug("event persist failed", exc_info=True)
 
     def stop(self) -> None:
+        if self.health is not None:
+            self.health.stop()
         self.controller.stop()
         if self.backend is not None:
             self.backend.stop()
+        if self.ckpt is not None:
+            self.ckpt.stop()
         self.store.stop_watchers()
 
     @classmethod

@@ -18,7 +18,15 @@ the served Store:
   loopback rewriting (kube-dns + headless-service analog);
 - serves pod logs over HTTP (``/logs/{ns}/{pod}``, with ``?follow=1``
   live tail) so the API server can proxy them to SDK clients (the
-  kubelet log API).
+  kubelet log API);
+- relays checkpoint coordination (controller/ckpt.py) in both
+  directions through the embedded ``LocalProcessBackend``: a preemption
+  notice stamped on a pod (save-before-evict barrier) is forwarded to
+  the worker process as a file (env ``TPUJOB_PREEMPT_FILE``), and the
+  worker's checkpoint state file (``TPUJOB_CKPT_FILE`` — periodic
+  saves, barrier acks, restore confirmations) is mirrored into the
+  pod's ``CheckpointRecord`` on the control plane, exactly like pod
+  phase reports.
 
 Run as: ``python -m tf_operator_tpu.runtime.agent --server http://...``.
 """

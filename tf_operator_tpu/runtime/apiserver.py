@@ -59,6 +59,7 @@ from typing import Dict, Optional, Type
 
 from tf_operator_tpu.api.serde import ApiObject
 from tf_operator_tpu.api.types import (
+    CheckpointRecord,
     ClusterQueue,
     Endpoint,
     EventRecord,
@@ -83,6 +84,7 @@ WIRE_KINDS: Dict[str, Type[ApiObject]] = {
     store_mod.SLICEGROUPS: SliceGroup,
     store_mod.TENANTQUEUES: TenantQueue,
     store_mod.CLUSTERQUEUES: ClusterQueue,
+    store_mod.CHECKPOINTRECORDS: CheckpointRecord,
     store_mod.EVENTS: EventRecord,
     store_mod.NODES: Node,
     leaderelection.LEASES: leaderelection.Lease,

@@ -40,6 +40,12 @@ REASON_SLICE_DRAIN_PENDING = "SliceDrainPending"
 REASON_SLICE_DRAINED = "SliceDrained"
 REASON_SLICE_REBOUND = "SliceRebound"
 
+# Checkpoint-coordination event reasons (controller/ckpt.py) — the
+# save-before-evict barrier's observable edges.
+REASON_CKPT_BARRIER_REQUESTED = "CheckpointBarrierRequested"
+REASON_CKPT_BARRIER_SAVED = "CheckpointBarrierSaved"
+REASON_CKPT_BARRIER_TIMEOUT = "CheckpointBarrierTimeout"
+
 # Tenant-queue quota event reasons (controller/quota.py) — the
 # quota-admission lifecycle's observable edges.
 REASON_QUEUED_WAITING_FOR_QUOTA = "QueuedWaitingForQuota"

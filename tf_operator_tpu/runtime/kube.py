@@ -59,6 +59,7 @@ from tf_operator_tpu.api.types import (
     Pod,
     PodSpec,
     PodStatus,
+    Toleration,
     TPUJob,
 )
 from tf_operator_tpu.runtime import metrics
@@ -532,6 +533,16 @@ def pod_to_k8s(pod: Pod) -> dict:
         spec["schedulerName"] = pod.spec.scheduler_name
     if pod.spec.node_selector:
         spec["nodeSelector"] = dict(pod.spec.node_selector)
+    if pod.spec.tolerations:
+        # Tolerations ride to the cluster verbatim — the google.com/tpu
+        # one stamped on gang workers (tpu_controller.set_cluster_spec)
+        # is what keeps GKE's TPU-nodepool taint manager off bound pods.
+        spec["tolerations"] = [
+            {k: v for k, v in (
+                ("key", t.key), ("operator", t.operator),
+                ("value", t.value), ("effect", t.effect),
+                ("tolerationSeconds", t.toleration_seconds)) if v}
+            for t in pod.spec.tolerations]
     if pod.spec.node_name:
         spec["nodeName"] = pod.spec.node_name
     return {"apiVersion": "v1", "kind": "Pod",
@@ -581,6 +592,13 @@ def pod_from_k8s(d: dict) -> Pod:
         restart_policy=spec_d.get("restartPolicy", "Never"),
         scheduler_name=spec_d.get("schedulerName", ""),
         node_selector=dict(spec_d.get("nodeSelector") or {}),
+        tolerations=[Toleration(
+            key=t.get("key", ""),
+            operator=t.get("operator", "Exists"),
+            value=t.get("value", ""),
+            effect=t.get("effect", ""),
+            toleration_seconds=t.get("tolerationSeconds"))
+            for t in spec_d.get("tolerations") or []],
         node_name=spec_d.get("nodeName", ""),
     )
     status = PodStatus(

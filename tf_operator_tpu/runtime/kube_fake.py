@@ -13,6 +13,15 @@ analog, hermetic and millisecond-fast):
   /apis/tpu-operator.dev/v1/.../tpujobs (+ /status subresource patch)
   PATCH is application/merge-patch+json (RFC 7386)
 
+RBAC is ENFORCED: the fake loads ``manifests/base/rbac.yaml`` (the
+ClusterRole the operator actually deploys with) and answers any request
+outside the granted verbs with 403 Forbidden, exactly like a real
+apiserver running the operator under its ServiceAccount — so a
+manifest/RBAC drift (a new write path without a new verb) fails the
+hermetic e2e suite instead of surfacing on a real cluster. Pass
+``rbac_path=None`` to run permissive, or point it at an alternate
+manifest to test tightened roles.
+
 The fake also plays kubelet: ``set_pod_phase`` fabricates the
 containerStatuses a node would report, which is how tests drive the
 lifecycle (the reference e2e does this through its Flask test-server's
@@ -24,6 +33,7 @@ from __future__ import annotations
 import datetime as _dt
 import json
 import logging
+import os
 import queue as _q
 import threading
 import urllib.parse
@@ -42,6 +52,40 @@ RESOURCES = ("pods", "services", "events", "leases",
 
 # Cluster-scoped resources live under the "" namespace key.
 _CLUSTER_SCOPED = ("nodes",)
+
+# API group per served resource (RBAC rule lookup key).
+_RESOURCE_GROUPS = {
+    "pods": "", "services": "", "events": "", "nodes": "",
+    "leases": "coordination.k8s.io",
+    "poddisruptionbudgets": "policy",
+    "customresourcedefinitions": "apiextensions.k8s.io",
+    constants.PLURAL: constants.GROUP,
+}
+
+# The checked-in ClusterRole the fake enforces by default.
+DEFAULT_RBAC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))),
+    "manifests", "base", "rbac.yaml")
+
+
+def load_rbac_rules(path: str) -> Dict[Tuple[str, str], set]:
+    """Parse ClusterRole rules out of an RBAC manifest into
+    {(apiGroup, resource): {verbs}} — subresources keep their
+    ``resource/sub`` names, exactly as K8s RBAC scopes them."""
+    import yaml
+
+    rules: Dict[Tuple[str, str], set] = {}
+    with open(path) as f:
+        for doc in yaml.safe_load_all(f):
+            if (doc or {}).get("kind") != "ClusterRole":
+                continue
+            for rule in doc.get("rules") or []:
+                verbs = set(rule.get("verbs") or [])
+                for g in rule.get("apiGroups") or []:
+                    for r in rule.get("resources") or []:
+                        rules.setdefault((g, r), set()).update(verbs)
+    return rules
 
 
 def _default_ns(resource: str, ns) -> str:
@@ -101,6 +145,9 @@ class FakeKubeState:
         # resource -> {(ns, name) -> dict}
         self.objects: Dict[str, Dict[Tuple[str, str], dict]] = {
             r: {} for r in RESOURCES}
+        # RBAC enforcement: {(apiGroup, resource): {verbs}} from the
+        # deployed ClusterRole (load_rbac_rules). None = permissive.
+        self.rbac_rules: Optional[Dict[Tuple[str, str], set]] = None
         self._rv = 0
         # (resource, queue) watch subscriptions
         self._watchers: List[Tuple[str, "_q.Queue"]] = []
@@ -143,6 +190,30 @@ class FakeKubeState:
     def next_rv(self) -> str:
         self._rv += 1
         return str(self._rv)
+
+    # -- RBAC --------------------------------------------------------------
+
+    def authorize(self, resource: str, verb: str,
+                  subresource: str = "") -> None:
+        """403 unless the loaded ClusterRole grants ``verb`` on the
+        resource (subresources are their own RBAC names, e.g.
+        ``pods/binding``). No rules loaded = permissive (unit tests
+        driving the state directly, or rbac_path=None)."""
+        rules = self.rbac_rules
+        if rules is None:
+            return
+        group = _RESOURCE_GROUPS.get(resource, "")
+        name = f"{resource}/{subresource}" if subresource else resource
+        for key in ((group, name), ("*", name), (group, "*"), ("*", "*")):
+            verbs = rules.get(key)
+            if verbs and ("*" in verbs or verb in verbs):
+                return
+        raise _HttpError(
+            403, "Forbidden",
+            f'operator cannot {verb} resource "{name}" in API group '
+            f'"{group}": not granted by the deployed ClusterRole '
+            "(manifests/base/rbac.yaml) — add the verb there if the "
+            "operator legitimately needs it")
 
     # -- CRUD (all under lock) --------------------------------------------
 
@@ -546,16 +617,21 @@ class _Handler(BaseHTTPRequestHandler):
         def run():
             resource, ns, name, sub, query = self._route()
             if resource == "_crd_probe":
+                self.state.authorize("customresourcedefinitions", "get")
                 return self._send_json(200, {
                     "kind": "CustomResourceDefinition",
                     "metadata": {"name": constants.CRD_NAME}})
             if resource == "pods" and name and sub == "log":
+                self.state.authorize("pods", "get", subresource="log")
                 return self._serve_pod_log(ns or "default", name, query)
             if name:
+                self.state.authorize(resource, "get")
                 return self._send_json(200, self.state.get(
                     resource, _default_ns(resource, ns), name))
             if query.get("watch") in ("1", "true"):
+                self.state.authorize(resource, "watch")
                 return self._serve_watch(resource, ns, query)
+            self.state.authorize(resource, "list")
             with self.state.lock:
                 self.state.list_counts[resource] = \
                     self.state.list_counts.get(resource, 0) + 1
@@ -568,6 +644,8 @@ class _Handler(BaseHTTPRequestHandler):
         def run():
             resource, ns, name, sub, _q2 = self._route()
             if resource == "pods" and name and sub == "binding":
+                self.state.authorize("pods", "create",
+                                     subresource="binding")
                 body = self._read_body()
                 target = (body.get("target") or {}).get("name", "")
                 if not target:
@@ -576,6 +654,7 @@ class _Handler(BaseHTTPRequestHandler):
                     ns or "default", name, target))
             if name:
                 raise _HttpError(405, "MethodNotAllowed", "POST to item")
+            self.state.authorize(resource, "create")
             self._send_json(201, self.state.create(
                 resource, _default_ns(resource, ns), self._read_body()))
         self._guard(run)
@@ -585,6 +664,7 @@ class _Handler(BaseHTTPRequestHandler):
             resource, ns, name, _, _q2 = self._route()
             if not name:
                 raise _HttpError(405, "MethodNotAllowed", "DELETE collection")
+            self.state.authorize(resource, "delete")
             self._send_json(200, self.state.delete(
                 resource, _default_ns(resource, ns), name))
         self._guard(run)
@@ -594,6 +674,7 @@ class _Handler(BaseHTTPRequestHandler):
             resource, ns, name, _, _q2 = self._route()
             if not name:
                 raise _HttpError(405, "MethodNotAllowed", "PUT collection")
+            self.state.authorize(resource, "update")
             self._send_json(200, self.state.replace(
                 resource, _default_ns(resource, ns), name,
                 self._read_body()))
@@ -604,6 +685,12 @@ class _Handler(BaseHTTPRequestHandler):
             resource, ns, name, sub, _q2 = self._route()
             if not name:
                 raise _HttpError(405, "MethodNotAllowed", "PATCH collection")
+            # Subresources are distinct RBAC names (tpujobs/status); the
+            # status writes of core resources (pods, nodes) are the fake
+            # kubelet's own — they arrive through the state helpers, not
+            # HTTP, so the role stays exactly what the OPERATOR needs.
+            self.state.authorize(resource, "patch",
+                                 subresource=sub)
             ctype = self.headers.get("Content-Type", "")
             if "merge-patch" not in ctype and "strategic" not in ctype:
                 raise _HttpError(415, "UnsupportedMediaType",
@@ -762,10 +849,22 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class FakeKubeApiServer:
-    """Serve a FakeKubeState over HTTP on a background thread."""
+    """Serve a FakeKubeState over HTTP on a background thread.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    ``rbac_path`` (default: the checked-in operator ClusterRole) is
+    loaded into the state's verb table and enforced on every HTTP
+    request; ``rbac_path=None`` serves permissively."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 rbac_path: Optional[str] = DEFAULT_RBAC_PATH):
         self.state = FakeKubeState()
+        if rbac_path is not None and os.path.exists(rbac_path):
+            try:
+                self.state.rbac_rules = load_rbac_rules(rbac_path)
+            except Exception:
+                log.warning("failed to load RBAC rules from %s; "
+                            "serving permissively", rbac_path,
+                            exc_info=True)
         handler = type("BoundHandler", (_Handler,), {"state": self.state})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True

@@ -61,6 +61,12 @@ class _RunningPod:
     # True while this pod's processes are counted in the backend's
     # gang-occupancy registry (see _gang_acquire/_gang_release).
     gang_held: bool = False
+    # Last preemption-notice payload forwarded to the worker process
+    # (dedup: each barrier's notice is written to the file once).
+    notice_written: str = ""
+    # mtime (ns) of the worker's checkpoint file at the last mirror into
+    # the store's CheckpointRecord.
+    ckpt_mtime: int = 0
 
 
 class LoopbackEnvResolver:
@@ -198,10 +204,17 @@ class LocalProcessBackend:
             if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
                 return  # terminal status echoes (incl. our own writes)
             with self._lock:
-                if key in self._running:
-                    return
-                rp = _RunningPod(pod=pod)
-                self._running[key] = rp
+                running = self._running.get(key)
+                if running is None:
+                    rp = _RunningPod(pod=pod)
+                    self._running[key] = rp
+            if running is not None:
+                # Already running here: the only update the data plane
+                # acts on is a preemption notice landing on the pod —
+                # forward it to the worker process as a file
+                # (controller/ckpt.py save-before-evict barrier).
+                self._forward_notice(running, pod)
+                return
             threading.Thread(target=self._run_pod, args=(key, rp),
                              daemon=True).start()
         elif event_type == DELETED:
@@ -212,11 +225,15 @@ class LocalProcessBackend:
                 # dispatcher thread free.
                 threading.Thread(target=self._terminate, args=(rp,),
                                  daemon=True).start()
-            # Log retention follows the pod object (kubelet semantics).
-            try:
-                os.unlink(self.pod_log_path(pod))
-            except OSError:
-                pass
+            # Log retention follows the pod object (kubelet semantics);
+            # the checkpoint-coordination sidecar files follow it too.
+            for path in (self.pod_log_path(pod),
+                         self.pod_preempt_path(pod),
+                         self.pod_ckpt_path(pod)):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
     # ------------------------------------------------------------------
 
@@ -314,6 +331,15 @@ class LocalProcessBackend:
         env.update(self.resolver.resolve(pod, container.env))
         env["TPUJOB_POD_NAME"] = pod.metadata.name
         env["TPUJOB_POD_NAMESPACE"] = pod.metadata.namespace
+        # Checkpoint-coordination handoff (controller/ckpt.py): where a
+        # preemption notice will appear, and where the worker publishes
+        # its checkpoint state for the plane to mirror into its
+        # CheckpointRecord (train/checkpoint.py CheckpointHook reads /
+        # writes these).
+        from tf_operator_tpu.api import constants as _c
+
+        env[_c.ENV_PREEMPT_FILE] = self.pod_preempt_path(pod)
+        env[_c.ENV_CKPT_FILE] = self.pod_ckpt_path(pod)
         os.makedirs(self.log_dir, exist_ok=True)
         log_path = self.pod_log_path(pod)
         with open(log_path, "ab") as log_file:
@@ -335,15 +361,129 @@ class LocalProcessBackend:
             self.log_dir,
             f"{pod.metadata.namespace}.{pod.metadata.name}.{uid}.log")
 
+    def pod_preempt_path(self, pod: Pod) -> str:
+        """Where this pod's worker process finds a preemption notice
+        (uid-keyed like the log: a recreated pod must never read the
+        dead incarnation's notice and 'ack' a barrier it never saved
+        under)."""
+        uid = (pod.metadata.uid or "nouid")[:8]
+        return os.path.join(
+            self.log_dir,
+            f"{pod.metadata.namespace}.{pod.metadata.name}.{uid}"
+            ".preempt.json")
+
+    def pod_ckpt_path(self, pod: Pod) -> str:
+        """Where this pod's worker process publishes checkpoint state
+        (saves / barrier acks / restore confirmation) for the plane to
+        mirror into its CheckpointRecord."""
+        uid = (pod.metadata.uid or "nouid")[:8]
+        return os.path.join(
+            self.log_dir,
+            f"{pod.metadata.namespace}.{pod.metadata.name}.{uid}"
+            ".ckpt.json")
+
+    def _forward_notice(self, rp: _RunningPod, pod: Pod) -> None:
+        """Write the pod's preemption-notice annotation to the worker's
+        notice file (atomic publish; the training loop polls it each
+        step). The kubelet analog of the coordinator's annotation stamp
+        reaching the container."""
+        from tf_operator_tpu.api import constants as _c
+
+        notice = pod.metadata.annotations.get(
+            _c.ANNOTATION_PREEMPT_NOTICE, "")
+        if not notice or rp.notice_written == notice:
+            return
+        path = self.pod_preempt_path(rp.pod)
+        try:
+            with open(path + ".tmp", "w") as f:
+                f.write(notice)
+            os.replace(path + ".tmp", path)
+        except OSError:
+            return  # next MODIFIED/poll retries
+        rp.notice_written = notice
+        log.info("preemption notice forwarded to pod %s/%s",
+                 pod.metadata.namespace, pod.metadata.name)
+
+    def _mirror_ckpt_record(self, rp: _RunningPod) -> None:
+        """Mirror the worker's checkpoint file into its CheckpointRecord
+        — the data plane reports checkpoint state exactly like it
+        reports pod phase (controller/ckpt.py reads the records to run
+        barriers and derive restore steps). A partially-written or
+        unparseable file is skipped; the next tick retries."""
+        pod = rp.pod
+        path = self.pod_ckpt_path(pod)
+        try:
+            mtime = os.stat(path).st_mtime_ns
+        except OSError:
+            return
+        if mtime == rp.ckpt_mtime:
+            return
+        import json as _json
+
+        try:
+            with open(path) as f:
+                data = _json.load(f)
+        except (OSError, ValueError):
+            return
+        rp.ckpt_mtime = mtime
+        from tf_operator_tpu.api import constants as _c
+        from tf_operator_tpu.api.types import (
+            CheckpointRecord,
+            CheckpointRecordStatus,
+            ObjectMeta,
+        )
+
+        restored = data.get("restored_from_step")
+        status = CheckpointRecordStatus(
+            step=int(data.get("step", -1)),
+            progress_step=int(data.get("progress_step",
+                                       data.get("step", -1))),
+            barrier_id=str(data.get("barrier", "")),
+            directory=str(data.get("directory", "")),
+            save_seconds=float(data.get("save_seconds", 0.0)),
+            restored_from_step=(int(restored) if restored is not None
+                                else None),
+            updated_at=_now())
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        try:
+            existing = self.store.try_get(store_mod.CHECKPOINTRECORDS,
+                                          ns, name)
+            if existing is None:
+                record = CheckpointRecord(
+                    metadata=ObjectMeta(
+                        name=name, namespace=ns,
+                        labels={k: v for k, v in pod.metadata.labels.items()
+                                if k in (_c.LABEL_JOB_NAME,
+                                         _c.LABEL_REPLICA_TYPE,
+                                         _c.LABEL_REPLICA_INDEX)},
+                        owner_references=[r.deepcopy() for r in
+                                          pod.metadata.owner_references]),
+                    status=status)
+                self.store.create(store_mod.CHECKPOINTRECORDS, record)
+            else:
+                existing.status = status
+                self.store.update_status(store_mod.CHECKPOINTRECORDS,
+                                         existing)
+        except (store_mod.AlreadyExistsError, store_mod.ConflictError,
+                store_mod.NotFoundError):
+            rp.ckpt_mtime = 0  # lost a race; next tick re-mirrors
+        except Exception:
+            log.debug("checkpoint record mirror failed", exc_info=True)
+            rp.ckpt_mtime = 0
+
     # ------------------------------------------------------------------
 
     def _wait_pod(self, key: str, rp: _RunningPod) -> None:
         """Monitor processes; honor pod restartPolicy; write final phase."""
         pod = rp.pod
         policy = pod.spec.restart_policy or RestartPolicy.NEVER
+        # A notice stamped while the pod was gate-held arrives with no
+        # further MODIFIED event; forward it now that processes exist.
+        self._forward_notice(rp, pod)
         while True:
             if rp.stop_requested:
                 return
+            self._mirror_ckpt_record(rp)
             exited = {}
             for name, proc in list(rp.processes.items()):
                 code = proc.poll()
@@ -372,6 +512,9 @@ class LocalProcessBackend:
                 phase = (PodPhase.SUCCEEDED
                          if all(c == 0 for c in exited.values())
                          else PodPhase.FAILED)
+                # Final mirror: a save completing in the process's last
+                # instants (barrier ack, then exit) must not be lost.
+                self._mirror_ckpt_record(rp)
                 self._gang_release(rp)  # natural death frees the chips
                 self._write_status(pod, phase, exit_codes=exited, rp=rp)
                 return

@@ -123,6 +123,17 @@ class Histogram(_Metric):
     def time(self, **labels: str) -> "_Timer":
         return _Timer(self, labels)
 
+    def sum_value(self, **labels: str) -> float:
+        """The series' cumulative _sum sample (benchmark artifacts read
+        totals without scraping the exposition text)."""
+        with self._lock:
+            return self._sums.get(self._key(labels), 0.0)
+
+    def count_value(self, **labels: str) -> int:
+        """The series' cumulative _count sample."""
+        with self._lock:
+            return self._totals.get(self._key(labels), 0)
+
     def render(self) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} histogram"]
@@ -336,3 +347,30 @@ queue_admission_wait_seconds = REGISTRY.histogram(
     "queue", ["queue"],
     buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
              300.0, 600.0, 1800.0))
+checkpoint_save_seconds = REGISTRY.histogram(
+    "tpu_operator_checkpoint_save_seconds",
+    "Wall time of one replica checkpoint save, as reported through "
+    "CheckpointRecords (periodic saves and barrier-forced saves alike)",
+    ["job_namespace"],
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0, 120.0))
+checkpoint_barrier_acks = REGISTRY.counter(
+    "tpu_operator_checkpoint_barrier_acks_total",
+    "Per-replica save acks received inside save-before-evict barriers",
+    ["job_namespace"])
+checkpoint_barriers = REGISTRY.counter(
+    "tpu_operator_checkpoint_barriers_total",
+    "Save-before-evict barriers completed, by outcome (acked = every "
+    "required replica saved; timeout = evicted at the deadline)",
+    ["job_namespace", "outcome"])
+steps_lost_per_disruption = REGISTRY.histogram(
+    "tpu_operator_steps_lost_per_disruption",
+    "Training steps lost to one planned disruption: last reported "
+    "progress minus the step the barrier committed",
+    ["job_namespace"],
+    buckets=(0.0, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0, 1000.0, 5000.0))
+job_goodput_ratio = REGISTRY.gauge(
+    "tpu_operator_job_goodput_ratio",
+    "Fraction of a job's training steps NOT lost to disruptions: "
+    "(progress - cumulative steps lost) / progress, 1.0 until the "
+    "first loss", ["job_namespace", "job"])

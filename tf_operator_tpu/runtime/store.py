@@ -363,3 +363,7 @@ NODES = "nodes"
 # reserved namespace "").
 TENANTQUEUES = "tenantqueues"
 CLUSTERQUEUES = "clusterqueues"
+# Checkpoint coordination (controller/ckpt.py): one record per replica,
+# named after the pod, labeled job-name — the save-before-evict barrier's
+# ack channel and the restore-step source.
+CHECKPOINTRECORDS = "checkpointrecords"

@@ -1,4 +1,5 @@
-"""Checkpoint/resume via orbax (async).
+"""Checkpoint/resume via orbax (async) + the coordinated-checkpoint
+worker hook.
 
 The reference has no checkpointing (SURVEY §5: operator is stateless,
 training checkpoints delegated to user containers mounting PVCs). Here it
@@ -6,15 +7,28 @@ is first-class so restart policies actually resume work: async saves
 overlap training (HBM->host copy happens at save(), serialization in the
 background), restores honor the target shardings (params land directly
 on their mesh positions).
+
+``CheckpointHook`` is the data-plane end of the control plane's
+CheckpointCoordinator (controller/ckpt.py): it runs the policy's
+periodic-save cadence, polls the preemption-notice file the node's data
+plane writes when a planned disruption opens a save-before-evict
+barrier, forces the final ``save(force=True)`` on a notice, and
+publishes every save / barrier ack / restore through the checkpoint
+state file the data plane mirrors into this pod's ``CheckpointRecord``.
+All file I/O is env-configured (``TPUJOB_PREEMPT_FILE`` /
+``TPUJOB_CKPT_FILE`` / ``TPUJOB_CKPT_*`` / ``TPUJOB_RESTORE_STEP``), so
+a training script needs exactly two calls: ``CheckpointHook.from_env``
+at startup and ``hook.after_step(step, state)`` in the loop.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import logging
-from typing import Any, Optional
-
-import jax
-import orbax.checkpoint as ocp
+import os
+import time
+from typing import Any, Callable, Dict, Optional
 
 log = logging.getLogger("tpu_operator.checkpoint")
 
@@ -22,6 +36,12 @@ log = logging.getLogger("tpu_operator.checkpoint")
 class Checkpointer:
     def __init__(self, directory: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1):
+        # Imported here, not at module top: CheckpointHook (and the
+        # worker_stub e2e payload using it) must be importable on the
+        # slim control-plane install, where jax/orbax are absent.
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
         self._mgr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
@@ -33,6 +53,7 @@ class Checkpointer:
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Async save; returns whether a save was started."""
+        ocp = self._ocp
         return self._mgr.save(step, args=ocp.args.StandardSave(state),
                               force=force)
 
@@ -44,6 +65,7 @@ class Checkpointer:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError("no checkpoint found")
+        ocp = self._ocp
         return self._mgr.restore(step,
                                  args=ocp.args.StandardRestore(abstract_state))
 
@@ -58,8 +80,208 @@ class Checkpointer:
         self._mgr.close()
 
 
+# ---------------------------------------------------------------------------
+# Coordinated checkpointing: the worker-process side of controller/ckpt.py
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Worker-side view of the job's CheckpointPolicy, rendered into pod
+    env by the controller (api/constants.py ENV_CKPT_*)."""
+
+    directory: str = ""
+    interval_steps: Optional[int] = None
+    interval_seconds: Optional[float] = None
+    max_to_keep: int = 3
+    restore_step: Optional[int] = None
+    preempt_file: str = ""
+    record_file: str = ""
+    # Publish a progress-only record update at most this often (steps
+    # reached between saves — the steps-lost-per-disruption numerator
+    # when a barrier times out).
+    progress_interval_seconds: float = 10.0
+
+    @classmethod
+    def from_env(cls, environ: Optional[Dict[str, str]] = None
+                 ) -> "CheckpointConfig":
+        env = os.environ if environ is None else environ
+
+        def _opt(key, cast):
+            raw = env.get(key, "")
+            return cast(raw) if raw else None
+
+        return cls(
+            directory=env.get("TPUJOB_CKPT_DIR", ""),
+            interval_steps=_opt("TPUJOB_CKPT_INTERVAL_STEPS", int),
+            interval_seconds=_opt("TPUJOB_CKPT_INTERVAL_SECONDS", float),
+            max_to_keep=int(env.get("TPUJOB_CKPT_MAX_TO_KEEP", "3") or 3),
+            restore_step=_opt("TPUJOB_RESTORE_STEP", int),
+            preempt_file=env.get("TPUJOB_PREEMPT_FILE", ""),
+            record_file=env.get("TPUJOB_CKPT_FILE", ""),
+        )
+
+
+class CheckpointHook:
+    """Coordinated-checkpoint loop hook (module docstring). Call
+    ``after_step(step, state)`` after every optimizer step:
+
+    - periodic cadence (interval_steps / interval_seconds) saves and
+      publishes the committed step;
+    - a preemption notice (save-before-evict barrier) forces a final
+      save, WAITS for durability, and publishes the barrier ack — the
+      coordinator releases the eviction on full-gang ack;
+    - between saves, cheap progress-only publishes keep the control
+      plane's steps-lost accounting honest.
+
+    ``checkpointer`` is anything with the ``Checkpointer`` surface
+    (save/wait/latest_step) — the orbax one in production, a trivial
+    file writer in hermetic tests. Saves initiated by the hook are
+    followed by ``wait()`` before the step is published as committed: a
+    step the control plane restores from must actually be on disk.
+    """
+
+    def __init__(self, checkpointer, config: CheckpointConfig,
+                 clock: Callable[[], float] = time.monotonic):
+        self.ckpt = checkpointer
+        self.config = config
+        self.clock = clock
+        self._committed: int = -1
+        self._restored_from: Optional[int] = None
+        self._acked_barrier: str = ""
+        self._last_save_time = clock()
+        self._last_progress_pub = 0.0
+        self._last_directory = config.directory
+
+    @classmethod
+    def from_env(cls, checkpointer=None,
+                 environ: Optional[Dict[str, str]] = None
+                 ) -> Optional["CheckpointHook"]:
+        """Build the hook from pod env; None when the job runs no
+        checkpoint policy (no TPUJOB_CKPT_DIR rendered)."""
+        config = CheckpointConfig.from_env(environ)
+        if not config.directory:
+            return None
+        if checkpointer is None:
+            checkpointer = Checkpointer(config.directory,
+                                        max_to_keep=config.max_to_keep)
+        return cls(checkpointer, config)
+
+    # -- restore ---------------------------------------------------------
+
+    def restore_step(self) -> Optional[int]:
+        """The step the control plane committed for this incarnation
+        (TPUJOB_RESTORE_STEP), falling back to the newest local
+        checkpoint. None = cold start."""
+        if self.config.restore_step is not None:
+            return self.config.restore_step
+        try:
+            return self.ckpt.latest_step()
+        except Exception:
+            return None
+
+    def note_restored(self, step: int) -> None:
+        """Record that this incarnation resumed from ``step`` — surfaces
+        as restoredFromStep on the job status."""
+        self._restored_from = step
+        self._committed = max(self._committed, step)
+        self._publish(progress=step)
+
+    # -- the per-step hook ------------------------------------------------
+
+    def after_step(self, step: int, state: Any) -> bool:
+        """Run the cadence + barrier logic for ``step`` (the number of
+        completed optimizer steps). Returns True when a save was
+        performed."""
+        notice = self._poll_notice()
+        if notice is not None:
+            return self._save(step, state,
+                              barrier=notice.get("barrier", ""))
+        if self._periodic_due(step):
+            return self._save(step, state)
+        now = self.clock()
+        if (self.config.record_file
+                and now - self._last_progress_pub
+                >= self.config.progress_interval_seconds):
+            self._publish(progress=step)
+        return False
+
+    def _periodic_due(self, step: int) -> bool:
+        cfg = self.config
+        if step <= self._committed:
+            return False
+        if cfg.interval_steps is not None and cfg.interval_steps > 0 \
+                and step % cfg.interval_steps == 0:
+            return True
+        return (cfg.interval_seconds is not None
+                and self.clock() - self._last_save_time
+                >= cfg.interval_seconds)
+
+    def _poll_notice(self) -> Optional[dict]:
+        path = self.config.preempt_file
+        if not path or not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                notice = json.load(f)
+        except (OSError, ValueError):
+            return None  # partial write; next step retries
+        if notice.get("barrier", "") == self._acked_barrier:
+            return None  # already saved + acked under this barrier
+        return notice
+
+    def _save(self, step: int, state: Any, barrier: str = "") -> bool:
+        t0 = self.clock()
+        try:
+            self.ckpt.save(step, state, force=True)
+            # Durability before publication: the control plane treats
+            # the published step as restorable, and a barrier ack
+            # releases an eviction — an in-flight async save must not
+            # count.
+            self.ckpt.wait()
+        except Exception:
+            # Neither commit nor ack is published: the barrier keeps
+            # waiting (bounded by its timeout) and the next step
+            # retries the save.
+            log.exception("checkpoint save at step %d failed", step)
+            return False
+        self._committed = step
+        self._last_save_time = self.clock()
+        if barrier:
+            self._acked_barrier = barrier
+            log.info("barrier %s: final checkpoint saved at step %d "
+                     "(%.2fs); acking", barrier, step,
+                     self._last_save_time - t0)
+        self._publish(progress=step, save_seconds=self._last_save_time - t0)
+        return True
+
+    def _publish(self, progress: int, save_seconds: float = 0.0) -> None:
+        """Atomic publish of this worker's checkpoint state; the data
+        plane mirrors it into the pod's CheckpointRecord."""
+        path = self.config.record_file
+        if not path:
+            return
+        payload = {
+            "step": self._committed,
+            "progress_step": max(progress, self._committed),
+            "barrier": self._acked_barrier,
+            "directory": self._last_directory,
+            "save_seconds": round(save_seconds, 4),
+            "restored_from_step": self._restored_from,
+        }
+        try:
+            with open(path + ".tmp", "w") as f:
+                json.dump(payload, f, sort_keys=True)
+            os.replace(path + ".tmp", path)
+        except OSError:
+            log.debug("checkpoint record publish failed", exc_info=True)
+            return
+        self._last_progress_pub = self.clock()
+
+
 def abstract_state_with_shardings(init_fn, shardings, *args):
     """eval_shape + sharding annotation, the StandardRestore target."""
+    import jax
+
     abstract = jax.eval_shape(init_fn, *args)
 
     def annotate(leaf, sharding):

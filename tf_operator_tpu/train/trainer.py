@@ -239,6 +239,32 @@ class Trainer:
         return run
 
 
+def run_train_steps(step_fn, state, batch_iter, num_steps: int,
+                    start_step: int = 0, ckpt_hook=None,
+                    on_metrics: Optional[Callable] = None):
+    """Drive ``num_steps`` optimizer steps through a compiled step
+    function, threading the coordinated-checkpoint hook
+    (train/checkpoint.py CheckpointHook) after every step — the loop
+    TFJob worker pods actually run.
+
+    The hook is where the control plane's save-before-evict barrier
+    lands in the training loop: a preemption notice forces a final
+    ``Checkpointer.save(force=True)`` + ack before the operator evicts
+    the gang, and periodic cadence saves run between disruptions. The
+    step counter is a plain Python int anchored at ``start_step`` (the
+    restored step), so checkpoint cadence never forces a device sync.
+    """
+    step = start_step
+    for _ in range(num_steps):
+        state, step_metrics = step_fn(state, next(batch_iter))
+        step += 1
+        if on_metrics is not None:
+            on_metrics(step, step_metrics)
+        if ckpt_hook is not None:
+            ckpt_hook.after_step(step, state)
+    return state
+
+
 def lm_loss(params, extra_vars, batch, model_apply):
     """Causal LM loss: predict tokens[1:] from tokens[:-1].
     Returns (loss, extra_vars) — aux carries mutable collections."""
