@@ -614,6 +614,11 @@ def run_disruption_bench(jobs: int, workers: int, threadiness: int,
     t0 = time.perf_counter()
     try:
         for i in range(jobs):
+            # The goodput gauge is process-global and keyed by job
+            # name: zero any residue from an earlier scenario sharing
+            # the bench namespace (the >0.0 filter below drops zeros).
+            metrics.job_goodput_ratio.set(0.0, job_namespace=NAMESPACE,
+                                          job=f"bench-{i:04d}")
             job = testutil.new_tpujob(worker=workers,
                                       name=f"bench-{i:04d}",
                                       namespace=NAMESPACE)
@@ -689,6 +694,373 @@ def run_disruption_bench(jobs: int, workers: int, threadiness: int,
     }
 
 
+def run_chaos_bench(jobs: int, workers: int, threadiness: int,
+                    timeout: float, profile_name: str = "default",
+                    seed: int = 0, disruptions: int = 2,
+                    steps: int = 60, save_interval: int = 15,
+                    chips_per_job: int = 4,
+                    barrier_timeout: float = 10.0,
+                    capacity_fraction: float = 0.6,
+                    kubelet_tick: float = 0.01,
+                    crash_restarts: int = 1,
+                    resync_period: float = 0.5,
+                    profile=None) -> Dict:
+    """Chaos scenario: the FULL control plane (gang admission +
+    checkpoint barriers + disruptions) reconciling through a seeded
+    ``FaultProfile`` (runtime/chaos.py) injected between the operator
+    and its store — write/read 5xx, 409 conflicts, timeouts, stale
+    reads, dropped watch events — plus ``crash_restarts`` operator
+    crash-restarts mid-run (all in-memory state lost, store survives).
+
+    Convergence itself is the headline; the artifact additionally
+    records the faults injected, in-place retry totals, degraded-mode
+    entries, and the post-convergence INVARIANT CHECKS (orphans,
+    duplicate admissions / capacity breaches, unresolved barriers,
+    committed-step regressions) — ``invariant_violations`` must be
+    empty for the run to count."""
+    from tf_operator_tpu.api.types import CheckpointPolicy
+    from tf_operator_tpu.controller.ckpt import CheckpointCoordinator
+    from tf_operator_tpu.controller.engine import EngineConfig
+    from tf_operator_tpu.controller.gang import (
+        PHASE_INQUEUE,
+        PHASE_RUNNING,
+        SliceGangScheduler,
+    )
+    from tf_operator_tpu.runtime import metrics
+    from tf_operator_tpu.runtime.chaos import (
+        ChaosStore,
+        FaultProfile,
+        crash_controller,
+    )
+    from tf_operator_tpu.runtime.retry import ControlPlaneHealth
+
+    base = Store()
+    if profile is None:
+        # An explicit FaultProfile (hack/verify-chaos-invariants.py
+        # randomizes one per seed) wins over the named preset.
+        profile = FaultProfile.named(profile_name, seed=seed)
+    chaos = ChaosStore(base, profile)
+    # Capacity below aggregate demand forces real queueing, so the
+    # duplicate-admission/capacity invariant is load-bearing, not
+    # vacuous. Chips free as jobs finish (slicegroup deleted).
+    total_chips = max(chips_per_job,
+                      int(jobs * chips_per_job * capacity_fraction))
+
+    holder: Dict[str, object] = {}
+    dur_acc: List[float] = []  # sync durations across crash-restarts
+
+    def build():
+        """(Re)build the operator assembly against the surviving
+        store — the cold-start path a crash-restart exercises."""
+        if "timer" in holder:
+            dur_acc.extend(holder["timer"].snapshot())
+        cp_health = ControlPlaneHealth(threshold_seconds=1.0)
+        ckpt = CheckpointCoordinator(chaos).start()
+        gang = SliceGangScheduler(chaos, total_chips=total_chips,
+                                  ckpt=ckpt, cp_health=cp_health)
+        ckpt.on_ack = gang.readmit
+        controller = TPUJobController(
+            chaos, config=EngineConfig(enable_gang_scheduling=True),
+            gang=gang, namespace=NAMESPACE, ckpt=ckpt,
+            cp_health=cp_health)
+        # Bench-proportionate expectations watchdog: dropped watch
+        # events must unblock in seconds, not the production 5 minutes.
+        controller.expectations._timeout = 2.0
+        holder.update(controller=controller, gang=gang, ckpt=ckpt,
+                      timer=_SyncTimer(controller))
+        controller.run(threadiness=threadiness)
+
+    def group_admitted(ns: str, job_name: str) -> bool:
+        g = base.try_get(store_mod.SLICEGROUPS, ns, job_name)
+        return g is not None and g.status.phase in (PHASE_INQUEUE,
+                                                    PHASE_RUNNING)
+
+    # Committed-step watermark per job (highest committed step observed
+    # at any displace) vs the steps each recreated incarnation restores
+    # from. The restore env is rendered at pod-CREATE time from the
+    # records at that instant, and the engine races the displacement
+    # (a pod recreated between the eviction's deletes and the displace
+    # landing sees the committed step of that moment), so a restore may
+    # legitimately trail the watermark by the in-flight barrier-ack
+    # spread — bounded by one save granule; the worker merely
+    # re-executes those steps, the durable checkpoint is untouched
+    # (found by verify-chaos-invariants seed 1004; docs/robustness.md
+    # "restore-step staleness"). What restart-with-identity must NEVER
+    # do once a gang checkpoint is committed: restore from scratch, or
+    # regress past a whole save granule.
+    # job -> (committed step, wall time the displace recorded it).
+    # Only incarnations CREATED after the stamp are judged: the engine
+    # recreates pods in the window between an eviction's deletes and
+    # the displace landing (their env predates the watermark — the
+    # seed-1004 render race), and the kubelet's tick may process a
+    # pod object listed before the deletion (seed-1020 TOCTOU) — both
+    # are pre-watermark incarnations, not lost steps.
+    watermark: Dict[str, tuple] = {}
+    violations: List[str] = []
+
+    class _ChaosKubelet(CkptFakeKubelet):
+        def _start(self, pod) -> None:
+            restore = None
+            for c in pod.spec.containers:
+                if constants.ENV_RESTORE_STEP in c.env:
+                    restore = int(c.env[constants.ENV_RESTORE_STEP])
+            job_name = pod.metadata.labels.get(
+                constants.LABEL_JOB_NAME, "")
+            if restore is None:
+                # Production semantics (train/checkpoint.py
+                # restore_step): no TPUJOB_RESTORE_STEP rendered means
+                # fall back to the NEWEST LOCAL CHECKPOINT, not a cold
+                # start. A pod whose env was rendered before the first
+                # commit but created after it (the in-place create
+                # retries widen that window — verify-chaos seed 1015)
+                # therefore still resumes from disk; the records are
+                # this harness's disk proxy.
+                steps = [r.status.step for r in base.list(
+                    store_mod.CHECKPOINTRECORDS, namespace=NAMESPACE,
+                    selector={constants.LABEL_JOB_NAME: job_name})
+                    if r.status.step >= 0]
+                restore = min(steps) if steps else 0
+                for c in pod.spec.containers:
+                    c.env[constants.ENV_RESTORE_STEP] = str(restore)
+            want = watermark.get(job_name)
+            created = pod.metadata.creation_timestamp
+            if (want is not None and created is not None
+                    and created.timestamp() > want[1]
+                    and (restore == 0
+                         or restore < want[0] - save_interval)):
+                violations.append(
+                    f"pod {pod.metadata.name} restored from step "
+                    f"{restore} with committed watermark {want[0]} "
+                    "(committed steps lost across restart)")
+            super()._start(pod)
+
+    kubelet = _ChaosKubelet(base, steps=steps, tick=kubelet_tick,
+                            admitted=group_admitted,
+                            save_interval=save_interval)
+
+    injected = [0]
+    stop_aux = threading.Event()
+    max_admitted = [0]
+
+    def disrupt() -> None:
+        """Round-robin planned disruptions through the (current)
+        coordinator + gang — every call may hit an injected fault;
+        level-triggered retry is the contract."""
+        cursor = 0
+        in_flight: Optional[str] = None
+        while not stop_aux.is_set() and injected[0] < disruptions:
+            ckpt = holder["ckpt"]
+            gang = holder["gang"]
+            try:
+                target = in_flight
+                if target is None:
+                    live = sorted(
+                        g.metadata.name
+                        for g in base.list(store_mod.SLICEGROUPS,
+                                           namespace=NAMESPACE)
+                        if g.status.phase in (PHASE_INQUEUE,
+                                              PHASE_RUNNING)
+                        and not g.status.displaced_reason)
+                    if not live:
+                        stop_aux.wait(kubelet_tick)
+                        continue
+                    target = live[cursor % len(live)]
+                    cursor += 1
+                if ckpt.ready_to_evict(NAMESPACE, target,
+                                       "chaos disruption"):
+                    committed = ckpt.committed_step(NAMESPACE, target)
+                    for p in base.list(
+                            store_mod.PODS, namespace=NAMESPACE,
+                            selector={constants.LABEL_JOB_NAME: target}):
+                        if p.status.phase not in ("Succeeded", "Failed"):
+                            base.try_delete(store_mod.PODS, NAMESPACE,
+                                            p.metadata.name)
+                    if gang.displace(NAMESPACE, target,
+                                     "chaos disruption"):
+                        if committed is not None:
+                            prev = watermark.get(target, (0, 0.0))
+                            watermark[target] = (
+                                max(prev[0], committed), time.time())
+                        injected[0] += 1
+                        in_flight = None
+                    else:
+                        in_flight = target
+                else:
+                    in_flight = target
+            except Exception:
+                pass  # injected fault; retry next tick
+            stop_aux.wait(kubelet_tick)
+
+    def resync() -> None:
+        """The production resync loop (cli.py _resync_loop analog):
+        the backstop that makes dropped watch events recoverable."""
+        while not stop_aux.wait(resync_period):
+            controller = holder.get("controller")
+            if controller is None:
+                continue
+            try:
+                for key in base.project(store_mod.TPUJOBS,
+                                        lambda j: j.key(),
+                                        namespace=NAMESPACE):
+                    controller.enqueue(key)
+            except Exception:
+                pass
+
+    def sample_admission() -> None:
+        """Duplicate-admission probe: the chips admitted concurrently
+        must never exceed the budget."""
+        while not stop_aux.wait(0.05):
+            used = sum(base.project(
+                store_mod.SLICEGROUPS,
+                lambda g: (chips_per_job
+                           if g.status.phase in (PHASE_INQUEUE,
+                                                 PHASE_RUNNING)
+                           else None)))
+            max_admitted[0] = max(max_admitted[0], used)
+
+    acked_before = metrics.checkpoint_barriers.value(
+        job_namespace=NAMESPACE, outcome="acked")
+    timeout_before = metrics.checkpoint_barriers.value(
+        job_namespace=NAMESPACE, outcome="timeout")
+    retries_before = sum(v for _, v in metrics.api_retries.collect())
+    degraded_before = sum(v for _, v in
+                          metrics.degraded_entries.collect()) or 0.0
+
+    build()
+    kubelet.start()
+    aux = [threading.Thread(target=fn, daemon=True, name=name)
+           for fn, name in ((disrupt, "disruptor"),
+                            (resync, "resync"),
+                            (sample_admission, "admission-probe"))]
+    t0 = time.perf_counter()
+    crashes_done = 0
+    try:
+        for i in range(jobs):
+            job = testutil.new_tpujob(worker=workers,
+                                      name=f"bench-{i:04d}",
+                                      namespace=NAMESPACE)
+            job.spec.slice.accelerator = f"v5e-{chips_per_job}"
+            job.spec.run_policy.checkpoint_policy = CheckpointPolicy(
+                enabled=True, directory="/bench/ckpt",
+                interval_steps=save_interval,
+                barrier_timeout_seconds=barrier_timeout)
+            base.create(store_mod.TPUJOBS, job)
+        for t in aux:
+            t.start()
+
+        deadline = t0 + timeout
+        while True:
+            succeeded = sum(base.project(
+                store_mod.TPUJOBS,
+                lambda j: 1 if cond.is_succeeded(j.status) else None,
+                namespace=NAMESPACE))
+            if (crashes_done < crash_restarts
+                    and succeeded >= max(1, jobs // 3)):
+                # Operator crash-restart mid-reconcile: kill the whole
+                # assembly (workqueue backlog, expectations, barrier
+                # deadlines — gone), cold-start a fresh one against the
+                # surviving store.
+                crash_controller(holder["controller"], holder["ckpt"])
+                crashes_done += 1
+                build()
+            if succeeded >= jobs:
+                # Converged. Disruptions are best-effort past this
+                # point: once every job finished there is no live gang
+                # left to displace, so waiting for the remaining count
+                # would hang forever (verify-chaos seed 1023) — the
+                # artifact reports how many actually landed.
+                break
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"{succeeded}/{jobs} jobs Succeeded, "
+                    f"{injected[0]}/{disruptions} disruptions after "
+                    f"{timeout}s")
+            time.sleep(0.02)
+        convergence = time.perf_counter() - t0
+    finally:
+        stop_aux.set()
+        kubelet.stop()
+        crash_controller(holder.get("controller"), holder.get("ckpt"))
+        base.stop_watchers()
+
+    # ---- post-convergence invariants (on the BASE store) -------------
+    live_jobs = {}
+    for j in base.list(store_mod.TPUJOBS, namespace=NAMESPACE):
+        live_jobs[j.metadata.uid] = j
+    seen_identity: Dict[tuple, str] = {}
+    for p in base.list(store_mod.PODS, namespace=NAMESPACE):
+        ref = p.metadata.controller_ref()
+        if ref is None or ref.uid not in live_jobs:
+            violations.append(
+                f"orphaned pod {p.metadata.name}: controller owner "
+                "missing from the store")
+            continue
+        if p.status.phase in ("Succeeded", "Failed"):
+            continue
+        ident = (ref.uid,
+                 p.metadata.labels.get(constants.LABEL_REPLICA_TYPE),
+                 p.metadata.labels.get(constants.LABEL_REPLICA_INDEX))
+        if ident in seen_identity:
+            violations.append(
+                f"duplicate live pods for identity {ident}: "
+                f"{seen_identity[ident]} and {p.metadata.name}")
+        seen_identity[ident] = p.metadata.name
+    if max_admitted[0] > total_chips:
+        violations.append(
+            f"admitted chips peaked at {max_admitted[0]} > budget "
+            f"{total_chips} (duplicate admission / double-booking)")
+    barriers_acked = int(metrics.checkpoint_barriers.value(
+        job_namespace=NAMESPACE, outcome="acked") - acked_before)
+    barriers_timeout = int(metrics.checkpoint_barriers.value(
+        job_namespace=NAMESPACE, outcome="timeout") - timeout_before)
+    if barriers_acked + barriers_timeout < injected[0]:
+        violations.append(
+            f"{injected[0]} disruptions displaced but only "
+            f"{barriers_acked + barriers_timeout} barriers resolved "
+            "(a barrier was left unresolved)")
+    finished = {(j.metadata.namespace, j.metadata.name)
+                for j in base.list(store_mod.TPUJOBS, namespace=NAMESPACE)
+                if cond.is_finished(j.status)}
+    in_flight_barriers = [
+        key for key, b in getattr(holder["ckpt"], "_barriers", {}).items()
+        if not b.outcome and key not in finished]
+    if in_flight_barriers:
+        violations.append(
+            f"in-flight barriers left at convergence: "
+            f"{in_flight_barriers}")
+
+    durations = dur_acc + holder["timer"].snapshot()
+    return {
+        "convergence_seconds": round(convergence, 3),
+        "jobs_per_sec": round(jobs / convergence, 2),
+        "syncs": len(durations),
+        "reconcile_p50_ms": round(_percentile(durations, 0.50) * 1e3, 3),
+        "reconcile_p99_ms": round(_percentile(durations, 0.99) * 1e3, 3),
+        "jobs": jobs,
+        "workers_per_job": workers,
+        "pods": jobs * workers,
+        "threadiness": threadiness,
+        "chaos_profile": profile_name,
+        "chaos_seed": seed,
+        "faults_injected": chaos.injector.snapshot(),
+        "faults_injected_total": chaos.injector.total,
+        "retries_total": int(
+            sum(v for _, v in metrics.api_retries.collect())
+            - retries_before),
+        "degraded_entries": int(
+            (sum(v for _, v in metrics.degraded_entries.collect()) or 0.0)
+            - degraded_before),
+        "crash_restarts": crashes_done,
+        "disruptions": disruptions,
+        "disruptions_injected": injected[0],
+        "barriers_acked": barriers_acked,
+        "barriers_timeout": barriers_timeout,
+        "total_chips": total_chips,
+        "max_admitted_chips": max_admitted[0],
+        "invariant_violations": violations,
+    }
+
+
 def _environment() -> Dict:
     """Environment fingerprint fields (auditable round-over-round):
     jax version + platform/chip kind when jax is importable, host facts
@@ -747,12 +1119,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="(--disruptions) fake training steps per job")
     p.add_argument("--save-interval", type=int, default=20,
                    help="(--disruptions) periodic-save cadence in steps")
+    p.add_argument("--chaos", default=None,
+                   choices=("off", "default", "heavy"),
+                   help="switches to the chaos scenario: gang + "
+                        "checkpoint barriers + disruptions reconciled "
+                        "through a seeded FaultProfile "
+                        "(runtime/chaos.py) with an operator "
+                        "crash-restart mid-run; the artifact records "
+                        "faults/retries/degraded entries and the "
+                        "post-convergence invariant checks "
+                        "(docs/robustness.md)")
+    p.add_argument("--chaos-seed", type=int, default=0,
+                   help="(--chaos) FaultProfile seed")
+    p.add_argument("--crash-restarts", type=int, default=1,
+                   help="(--chaos) operator crash-restarts to inject")
     args = p.parse_args(argv)
 
     config = {"jobs": args.jobs, "workers": args.workers,
               "threadiness": args.threadiness,
               "kubelet_tick": args.kubelet_tick}
-    if args.tenants > 0:
+    if args.chaos is not None:
+        config.update({"chaos": args.chaos, "seed": args.chaos_seed,
+                       "crash_restarts": args.crash_restarts})
+        metric = (f"controlplane_chaos_convergence_jobs_per_sec"
+                  f"[{args.jobs}x{args.workers} {args.chaos}]")
+    elif args.tenants > 0:
         config.update({"tenants": args.tenants,
                        "chips_per_job": args.chips_per_job})
         metric = (f"controlplane_tenant_convergence_jobs_per_sec"
@@ -767,7 +1158,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         metric = (f"controlplane_convergence_jobs_per_sec"
                   f"[{args.jobs}x{args.workers}]")
     try:
-        if args.tenants > 0:
+        if args.chaos is not None:
+            result = run_chaos_bench(
+                args.jobs, args.workers, args.threadiness, args.timeout,
+                profile_name=args.chaos, seed=args.chaos_seed,
+                disruptions=max(args.disruptions, 2),
+                crash_restarts=args.crash_restarts,
+                kubelet_tick=args.kubelet_tick)
+        elif args.tenants > 0:
             result = run_tenant_bench(
                 args.tenants, args.jobs, args.workers, args.threadiness,
                 args.timeout, chips_per_job=args.chips_per_job,
@@ -794,6 +1192,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             "env": _environment(),
             "config_fingerprint": config_fingerprint(config),
         }))
+        if result.get("invariant_violations"):
+            # Converged, but a chaos invariant broke: the artifact
+            # carries the details; the exit code fails the run.
+            return 1
         return 0
     except Exception as e:  # one JSON line, even on failure
         print(json.dumps({

@@ -36,6 +36,18 @@ def maybe_init_distributed() -> int:
             and os.environ.get("TPUJOB_JAX_DISTRIBUTED") == "1"):
         import jax
 
+        if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+            # The default CPU backend refuses multiprocess computations
+            # ("Multiprocess computations aren't implemented on the CPU
+            # backend"); the gloo collectives implementation lifts that,
+            # which is what makes the hermetic two-process e2e real.
+            # Best-effort: older jaxlibs without the flag fall through
+            # and fail with the stock message.
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=num, process_id=pid)
     return pid

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Randomized chaos property check for the whole control plane
+(controller + gang admission + checkpoint barriers, runtime/chaos.py
+fault injection).
+
+Each round draws a random fleet shape (jobs x workers), a random
+``FaultProfile`` (write/read 5xx, 409 conflicts, timeouts, stale reads,
+dropped watch events — every class at a non-trivial rate) and a random
+number of planned disruptions, then runs REAL reconciliation through
+the fault-injecting store with one operator crash-restart mid-run, and
+asserts the post-convergence invariants:
+
+1. **Convergence**: every job reaches Succeeded despite the faults —
+   level-triggered reconcile + the shared retry layer
+   (runtime/retry.py) must absorb any profile, given time.
+2. **No orphaned pods**: every pod's controller owner exists; no two
+   live pods share a (job, replica-type, index) identity (a lost
+   expectation would double-create).
+3. **No duplicate gang admissions**: concurrently admitted chips never
+   exceed the budget at any sampled instant (sampled at 20 Hz while
+   the run churns).
+4. **Every opened checkpoint barrier resolves**: acked or timeout —
+   displacements only execute after a barrier outcome, and none is
+   left in flight at convergence.
+5. **Restart-with-identity never loses committed steps**: no recreated
+   worker restores from below the committed-step watermark recorded at
+   its eviction.
+
+The harness is ``benchmarks/bench_controlplane.py run_chaos_bench`` —
+the same machinery the ``--chaos`` scenario pins at the 200x16 shape —
+so the fuzz and the benchmark can never drift apart.
+
+Usage:
+    python hack/verify-chaos-invariants.py                 # 10 rounds
+    python hack/verify-chaos-invariants.py --rounds 3 --seed 7
+
+Exit status 0 = all rounds clean; 1 = a violation, with the repro seed
+on stderr. Wired into tier-1 as tests/test_chaos_invariants.py (smoke
+round count, pinned seed list including every regression seed found
+during development).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "benchmarks"))
+
+import bench_controlplane  # noqa: E402
+
+from tf_operator_tpu.runtime.chaos import FaultProfile  # noqa: E402
+
+
+def random_profile(rng: random.Random, seed: int) -> FaultProfile:
+    """Every fault class at a non-trivial random rate — mean enough to
+    exercise each retry/recovery path, bounded so convergence stays
+    reachable inside a CI-sized timeout."""
+    return FaultProfile(
+        seed=seed,
+        write_error_rate=rng.uniform(0.02, 0.10),
+        conflict_rate=rng.uniform(0.02, 0.10),
+        read_error_rate=rng.uniform(0.01, 0.05),
+        timeout_rate=rng.uniform(0.01, 0.04),
+        stale_read_rate=rng.uniform(0.02, 0.08),
+        watch_drop_rate=rng.uniform(0.02, 0.08),
+        lost_response_rate=rng.uniform(0.0, 0.02),
+    )
+
+
+def run_round(seed: int, timeout: float = 120.0,
+              verbose: bool = False) -> List[str]:
+    """One randomized round; returns invariant violations ([] = clean).
+    A convergence timeout IS a violation — under any profile the fleet
+    must converge, that is the level-triggered contract."""
+    rng = random.Random(seed)
+    jobs = rng.randint(3, 6)
+    workers = rng.randint(2, 3)
+    disruptions = rng.randint(1, 2)
+    profile = random_profile(rng, seed)
+    try:
+        result = bench_controlplane.run_chaos_bench(
+            jobs=jobs, workers=workers, threadiness=rng.choice((2, 4)),
+            timeout=timeout, seed=seed, profile=profile,
+            disruptions=disruptions, steps=30, save_interval=8,
+            barrier_timeout=8.0, crash_restarts=1,
+            resync_period=0.25)
+    except TimeoutError as e:
+        return [f"no convergence under profile seed {seed}: {e}"]
+    if verbose:
+        print(f"  seed {seed}: {jobs}x{workers} d{disruptions} "
+              f"faults={result['faults_injected_total']} "
+              f"retries={result['retries_total']} "
+              f"converged {result['convergence_seconds']}s",
+              file=sys.stderr)
+    return list(result["invariant_violations"])
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    p.add_argument("--rounds", type=int, default=10)
+    p.add_argument("--seed", type=int, default=None,
+                   help="base seed (default: random; printed for repro)")
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="per-round convergence budget in seconds")
+    p.add_argument("--verbose", action="store_true")
+    args = p.parse_args(argv)
+    base = args.seed if args.seed is not None else \
+        random.SystemRandom().randint(0, 2**31)
+    print(f"verify-chaos-invariants: {args.rounds} rounds, "
+          f"base seed {base}", file=sys.stderr)
+    for i in range(args.rounds):
+        seed = base + i
+        errors = run_round(seed, timeout=args.timeout,
+                           verbose=args.verbose)
+        if errors:
+            print(f"FAIL (repro: --seed {seed} --rounds 1):",
+                  file=sys.stderr)
+            for e in errors:
+                print(f"  {e}", file=sys.stderr)
+            return 1
+    print("OK: converged under every fault profile; no orphans, no "
+          "duplicate admissions, every barrier resolved, no committed "
+          "steps lost", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

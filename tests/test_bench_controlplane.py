@@ -127,6 +127,46 @@ def test_disruption_scenario_smoke_and_artifact_schema(capsys):
     assert ENV_KEYS <= set(artifact["env"])
 
 
+def test_chaos_scenario_smoke_and_artifact_schema(capsys):
+    """--chaos default: the full control plane (gang + barriers +
+    disruptions) reconciling through the seeded FaultProfile with an
+    operator crash-restart mid-run. The smoke pin: the fleet converges,
+    faults were actually injected, the invariant checks come back
+    EMPTY, and the artifact carries the chaos fields the acceptance
+    criteria read (retry totals, degraded entries, crash count)."""
+    rc = bench_controlplane.main(["--jobs", "4", "--workers", "2",
+                                  "--chaos", "default",
+                                  "--chaos-seed", "7",
+                                  "--timeout", "120"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "artifact must be exactly one line"
+    artifact = json.loads(out[0])
+    assert rc == 0, artifact.get("invariant_violations",
+                                 artifact.get("error"))
+    assert artifact["metric"].startswith(
+        "controlplane_chaos_convergence_jobs_per_sec")
+    assert {"chaos_profile", "chaos_seed", "faults_injected",
+            "faults_injected_total", "retries_total",
+            "degraded_entries", "crash_restarts",
+            "disruptions_injected", "barriers_acked",
+            "barriers_timeout", "max_admitted_chips", "total_chips",
+            "invariant_violations"} <= set(artifact)
+    assert artifact["chaos_profile"] == "default"
+    # The profile actually bit: faults were injected across classes,
+    # and the default profile carries the acceptance-criteria floors
+    # (>=5% write errors, >=5% conflicts).
+    assert artifact["faults_injected_total"] > 0
+    assert artifact["crash_restarts"] == 1
+    # Disruptions are best-effort once the fleet converges; at this
+    # shape at least one always lands.
+    assert artifact["disruptions_injected"] >= 1
+    assert (artifact["barriers_acked"] + artifact["barriers_timeout"]
+            >= artifact["disruptions_injected"])
+    assert artifact["invariant_violations"] == []
+    assert artifact["max_admitted_chips"] <= artifact["total_chips"]
+    assert ENV_KEYS <= set(artifact["env"])
+
+
 def test_failure_still_emits_one_json_line(capsys):
     # Impossible timeout: the artifact contract holds on failure too.
     rc = bench_controlplane.main(["--jobs", "2", "--workers", "1",

@@ -1,0 +1,199 @@
+"""Leader election under injected renew failures — the first slice of
+ROADMAP item 4's failover arc.
+
+The elector existed but had no coverage for the path that matters at
+pod scale: the LEADER's lease renewals start failing (API-server storm,
+partition) mid-reconcile, it must step down, a follower must take over
+the expired lease, and the handoff must not converge any job twice
+(duplicate pod creates, double-counted success) — the single-writer
+guarantee leader election exists to provide.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+from bench_controlplane import NAMESPACE, FakeKubelet  # noqa: E402
+
+from tf_operator_tpu import testutil  # noqa: E402
+from tf_operator_tpu.controller import conditions as cond  # noqa: E402
+from tf_operator_tpu.controller.tpu_controller import (  # noqa: E402
+    TPUJobController,
+)
+from tf_operator_tpu.runtime import metrics  # noqa: E402
+from tf_operator_tpu.runtime import store as store_mod  # noqa: E402
+from tf_operator_tpu.runtime.leaderelection import (  # noqa: E402
+    LEASES,
+    LeaderElector,
+)
+from tf_operator_tpu.runtime.retry import TransientAPIError  # noqa: E402
+from tf_operator_tpu.runtime.store import Store  # noqa: E402
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = predicate()
+        if result:
+            return result
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+class FlakyLeaseStore:
+    """Store facade for one elector whose LEASE writes can be cut off —
+    the injected-renew-failure seam (an API server that stops answering
+    this replica's renewals while everything else still works)."""
+
+    def __init__(self, inner: Store):
+        self.inner = inner
+        self.fail_lease_writes = False
+
+    def try_get(self, kind, ns, name):
+        return self.inner.try_get(kind, ns, name)
+
+    def create(self, kind, obj):
+        if kind == LEASES and self.fail_lease_writes:
+            raise TransientAPIError("injected: lease write refused")
+        return self.inner.create(kind, obj)
+
+    def update(self, kind, obj):
+        if kind == LEASES and self.fail_lease_writes:
+            raise TransientAPIError("injected: lease write refused")
+        return self.inner.update(kind, obj)
+
+
+def _elector(store, identity, on_start=None, on_stop=None):
+    return LeaderElector(store, identity=identity, namespace="default",
+                         lease_duration=1.0, renew_deadline=0.4,
+                         retry_period=0.05,
+                         on_started_leading=on_start,
+                         on_stopped_leading=on_stop)
+
+
+def test_leader_steps_down_on_renew_failures_and_follower_takes_over():
+    base = Store()
+    flaky = FlakyLeaseStore(base)
+    stopped = threading.Event()
+
+    a = _elector(flaky, "replica-a", on_stop=stopped.set)
+    b = _elector(base, "replica-b")
+    a.start()
+    assert a.wait_until_leading(timeout=5.0)
+    b.start()
+    time.sleep(0.2)
+    assert not b.is_leader  # standby while the lease is live
+
+    # The API server stops answering A's lease writes: A must step
+    # down within its renew deadline, not keep acting as leader.
+    flaky.fail_lease_writes = True
+    assert stopped.wait(timeout=5.0), "leader never stepped down"
+    assert not a.is_leader
+
+    # B takes over the EXPIRED lease (duration 1s) and records the
+    # transition on the lock object.
+    wait_for(lambda: b.is_leader, timeout=5.0,
+             message="follower to take over the expired lease")
+    lease = base.try_get(LEASES, "default", "tpu-operator")
+    assert lease.spec.holder_identity == "replica-b"
+    assert lease.spec.lease_transitions >= 1
+    a.stop()
+    b.stop()
+
+
+def test_failover_mid_reconcile_converges_each_job_exactly_once():
+    """Leader loses the lease MID-RECONCILE (its jobs not yet
+    converged), the follower takes over, and the fleet converges with
+    exactly one success transition and exactly one pod-create per
+    replica — the follower ADOPTS the surviving pods instead of
+    re-creating them (crash-safe reconcile: all leader in-memory state
+    is lost with the stepdown; the store is the only carryover)."""
+    base = Store()
+    flaky = FlakyLeaseStore(base)
+    workers = 3
+
+    gate = threading.Event()  # pods held Pending until failover
+
+    controllers = {}
+
+    def make(identity, lease_store):
+        controller = TPUJobController(base, namespace=NAMESPACE)
+        controllers[identity] = controller
+        elector = _elector(
+            lease_store, identity,
+            on_start=lambda: controller.run(threadiness=2),
+            on_stop=controller.stop)
+        return elector
+
+    a = make("replica-a", flaky)
+    b = make("replica-b", base)
+    kubelet = FakeKubelet(base, tick=0.01,
+                          admitted=lambda ns, job: gate.is_set())
+
+    succ_before = metrics.jobs_successful.value(job_namespace=NAMESPACE)
+    created_before = metrics.created_pods.value(job_namespace=NAMESPACE)
+
+    a.start()
+    assert a.wait_until_leading(timeout=5.0)
+    b.start()
+    kubelet.start()
+    try:
+        job = testutil.new_tpujob(worker=workers, name="failover",
+                                  namespace=NAMESPACE)
+        base.create(store_mod.TPUJOBS, job)
+
+        # Leader A creates the pods; the gate keeps them Pending so
+        # the job is mid-reconcile when the lease is cut.
+        wait_for(lambda: base.count(store_mod.PODS) == workers,
+                 message="leader to create the gang's pods")
+        flaky.fail_lease_writes = True
+        wait_for(lambda: b.is_leader, timeout=5.0,
+                 message="follower to take over")
+        assert not a.is_leader
+
+        # Now let the pods run to completion under the NEW leader.
+        gate.set()
+        wait_for(lambda: cond.is_succeeded(
+            base.get(store_mod.TPUJOBS, NAMESPACE, "failover").status),
+            timeout=15.0, message="job to converge under the follower")
+    finally:
+        kubelet.stop()
+        a.stop()
+        b.stop()
+        for c in controllers.values():
+            try:
+                c.stop()
+            except Exception:
+                pass
+        base.stop_watchers()
+
+    # Exactly ONE success transition and ONE create per replica: the
+    # follower adopted A's pods, it did not double-create or
+    # double-converge.
+    assert metrics.jobs_successful.value(
+        job_namespace=NAMESPACE) == succ_before + 1
+    assert metrics.created_pods.value(
+        job_namespace=NAMESPACE) == created_before + workers
+
+
+def test_released_lease_hands_over_immediately():
+    base = Store()
+    a = _elector(base, "replica-a")
+    b = _elector(base, "replica-b")
+    a.start()
+    assert a.wait_until_leading(timeout=5.0)
+    b.start()
+    a.stop()  # voluntary stop releases the lease
+    wait_for(lambda: b.is_leader, timeout=5.0,
+             message="follower takeover after voluntary release")
+    b.stop()
+
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

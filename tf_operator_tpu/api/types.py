@@ -105,6 +105,12 @@ class JobConditionType:
     # evicting. Flips to status False on full-gang ack or barrier
     # timeout. No reference analog.
     CHECKPOINT_BARRIER = "CheckpointBarrier"
+    # TPU extension (runtime/retry.py ControlPlaneHealth): the
+    # operator's API server has been unreachable past the degraded
+    # threshold — reconciling continues, but new drains/reclaims/
+    # preemptions are deferred until it answers again (flips to status
+    # False on recovery). No reference analog.
+    CONTROLPLANE_DEGRADED = "ControlPlaneDegraded"
     RUNNING = "Running"
     RESTARTING = "Restarting"
     SUCCEEDED = "Succeeded"

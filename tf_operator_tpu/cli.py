@@ -163,6 +163,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "Takes effect on the kube backend with the "
                         "gang binder, and on the local/served backends "
                         "with --enable-gang-scheduling")
+    p.add_argument("--degraded-after-seconds", type=float, default=10.0,
+                   help="enter degraded mode after the API server has "
+                        "been failing this long (plus 5 consecutive "
+                        "failures): reconciling continues but new "
+                        "drains/reclaims/preemptions are deferred and "
+                        "jobs carry a ControlPlaneDegraded condition "
+                        "until it recovers (docs/robustness.md)")
     p.add_argument("--health-drain-grace-seconds", type=float,
                    default=0.0,
                    help="operator-wide default for the observed-"
@@ -287,6 +294,8 @@ class Server:
                 slice_health=getattr(args, "slice_health", True),
                 health_drain_grace_seconds=getattr(
                     args, "health_drain_grace_seconds", 0.0),
+                degraded_after_seconds=getattr(
+                    args, "degraded_after_seconds", 10.0),
                 **gang_kwargs)
             self.store = self.operator.store
             self._lease_store = KubeLeaseStore(client)
@@ -307,6 +316,8 @@ class Server:
                     and args.enable_gang_scheduling),
                 health_drain_grace_seconds=getattr(
                     args, "health_drain_grace_seconds", 0.0),
+                degraded_after_seconds=getattr(
+                    args, "degraded_after_seconds", 10.0),
                 **gang_kwargs, **tenant_kwargs, **op_kwargs)
         self.api_server = None
         if getattr(args, "api_port", 0) != 0:

@@ -138,6 +138,19 @@ class SliceGangBinder:
         # Groups already flagged unplaceable (event once per episode;
         # cleared when the group binds or goes away).
         self._warned_unplaceable: set = set()
+        # (ns, pod) -> consecutive bind failures. Failures used to be
+        # invisible beyond a log line: the pod just stayed Pending and
+        # "retry next pass" could mask a permanently failing bind
+        # (RBAC drift, node gone from the apiserver's view) forever.
+        # Now every failure counts in bind_failures_total{reason} and
+        # the SAME pod failing repeatedly raises a BindFailing event on
+        # its job (once per episode; cleared on success/conflict).
+        self._bind_failures: Dict[Tuple[str, str], int] = {}
+        self._warned_bind_failing: set = set()
+
+    # Consecutive per-pod failures before the job gets a BindFailing
+    # event (one transient blip is business as usual).
+    BIND_FAILING_EVENT_THRESHOLD = 3
 
     # -- lifecycle -------------------------------------------------------
 
@@ -260,6 +273,14 @@ class SliceGangBinder:
             bound += self._place_group(ns, name, sg, group_pods, pods,
                                        states, domain_of_any)
         self._warned_unplaceable &= live_groups
+        # Failure streaks die with their pods (a deleted-and-recreated
+        # pod starts a fresh episode).
+        live_pods = {(p.metadata.namespace, p.metadata.name)
+                     for group_pods in unbound.values()
+                     for p in group_pods}
+        for key in [k for k in self._bind_failures if k not in live_pods]:
+            del self._bind_failures[key]
+        self._warned_bind_failing &= live_pods
         return bound
 
     def _place_group(self, ns: str, name: str, sg: SliceGroup,
@@ -434,21 +455,48 @@ class SliceGangBinder:
     def _bind(self, pod: Pod, st: _NodeState) -> str:
         """-> "bound" | "conflict" (another binder won: settled) |
         "failed" (transport/server error: retry next pass)."""
+        from tf_operator_tpu.runtime import retry as retry_mod
+
         ns, name = pod.metadata.namespace, pod.metadata.name
+        key = (ns, name)
         try:
-            self.client.bind_pod(ns, name, st.name)
+            # Transient blips retry in place (runtime/retry.py) so one
+            # 500 doesn't cost a whole binder pass; what survives the
+            # backoff is a real failure, counted and retried next pass.
+            retry_mod.with_retries(
+                lambda: self.client.bind_pod(ns, name, st.name),
+                component="binder.bind")
         except store_mod.ConflictError:
             # Another binder (or an earlier pass whose MODIFIED event
             # hasn't mirrored yet) placed it: settled.
             log.debug("pod %s/%s already bound", ns, name)
+            self._bind_failures.pop(key, None)
+            self._warned_bind_failing.discard(key)
             return "conflict"
         except store_mod.NotFoundError:
+            metrics.bind_failures.inc(reason="vanished")
+            self._bind_failures.pop(key, None)
             return "failed"  # deleted under us; nothing to place
         except Exception as e:
-            log.warning("binding pod %s/%s to %s failed (will retry): %s",
-                        ns, name, st.name, e)
+            metrics.bind_failures.inc(reason="error")
+            failures = self._bind_failures.get(key, 0) + 1
+            self._bind_failures[key] = failures
+            log.warning("binding pod %s/%s to %s failed (%d in a row, "
+                        "will retry): %s", ns, name, st.name, failures, e)
+            if (failures >= self.BIND_FAILING_EVENT_THRESHOLD
+                    and key not in self._warned_bind_failing):
+                self._warned_bind_failing.add(key)
+                group = pod.metadata.annotations.get(
+                    constants.ANNOTATION_GANG_GROUP, name)
+                self._record(ns, group, EVENT_TYPE_WARNING, "BindFailing",
+                             f"Binding pod {name} has failed "
+                             f"{failures} consecutive passes "
+                             f"(latest: {e}); it will stay Pending "
+                             "until the bind succeeds")
             return "failed"
         metrics.gang_pods_bound.inc(job_namespace=ns)
+        self._bind_failures.pop(key, None)
+        self._warned_bind_failing.discard(key)
         log.info("bound pod %s/%s -> node %s (ici-domain %s)",
                  ns, name, st.name, st.domain)
         return "bound"

@@ -263,6 +263,8 @@ class CheckpointCoordinator:
                 "%Y-%m-%dT%H:%M:%SZ"),
             "reason": barrier.reason,
         }, sort_keys=True)
+        from tf_operator_tpu.runtime import retry as retry_mod
+
         for pod in pods:
             if pod.metadata.name in barrier.stamped:
                 continue
@@ -270,14 +272,42 @@ class CheckpointCoordinator:
                     constants.ANNOTATION_PREEMPT_NOTICE) == notice:
                 barrier.stamped.add(pod.metadata.name)
                 continue
-            fresh = pod.deepcopy()
-            fresh.metadata.annotations[
-                constants.ANNOTATION_PREEMPT_NOTICE] = notice
+
+            def stamp(cur):
+                if cur.metadata.annotations.get(
+                        constants.ANNOTATION_PREEMPT_NOTICE) == notice:
+                    return False  # already carries this barrier's notice
+                cur.metadata.annotations[
+                    constants.ANNOTATION_PREEMPT_NOTICE] = notice
+
+            # Conflict-aware read-modify-write (runtime/retry.py): the
+            # notice races the kubelet's status writes on every pod of
+            # the gang — losing the CAS used to delay the stamp (and so
+            # the worker's final save) a full consult cycle per loss;
+            # re-reading and re-stamping in place converges the whole
+            # gang in one pass. A pod deleted under us stays unstamped;
+            # the next consult re-derives membership.
             try:
-                self.store.update(store_mod.PODS, fresh)
-            except (store_mod.ConflictError, store_mod.NotFoundError):
-                continue  # racing write/delete; next consult re-stamps
-            barrier.stamped.add(pod.metadata.name)
+                written = retry_mod.update_with_conflict_retry(
+                    self.store, store_mod.PODS, pod.metadata.namespace,
+                    pod.metadata.name, stamp, component="ckpt.stamp")
+            except Exception:
+                log.debug("stamping notice on %s/%s failed; next "
+                          "consult re-stamps", pod.metadata.namespace,
+                          pod.metadata.name, exc_info=True)
+                continue
+            if written is not None or pod.metadata.name in (
+                    barrier.stamped):
+                barrier.stamped.add(pod.metadata.name)
+            elif written is None:
+                # stamp() aborted because the notice is already there
+                # (a racing earlier pass won) — that still counts.
+                cur = self.store.try_get(store_mod.PODS,
+                                         pod.metadata.namespace,
+                                         pod.metadata.name)
+                if cur is not None and cur.metadata.annotations.get(
+                        constants.ANNOTATION_PREEMPT_NOTICE) == notice:
+                    barrier.stamped.add(pod.metadata.name)
 
     def _count_acks(self, namespace: str, barrier: _Barrier,
                     records: List[CheckpointRecord]) -> None:

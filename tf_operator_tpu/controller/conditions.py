@@ -34,6 +34,10 @@ JOB_RESTARTING_REASON = "JobRestarting"
 JOB_QUEUED_REASON = "QueuedWaitingForQuota"
 JOB_QUOTA_ADMITTED_REASON = "QuotaAdmitted"
 JOB_QUOTA_EXCEEDED_REASON = "QuotaExceeded"
+# TPU extensions (runtime/retry.py): degraded-mode arc — the API server
+# was failing past the threshold / answered again.
+JOB_CONTROLPLANE_DEGRADED_REASON = "ControlPlaneDegraded"
+JOB_CONTROLPLANE_RECOVERED_REASON = "ControlPlaneRecovered"
 
 
 def _now() -> _dt.datetime:

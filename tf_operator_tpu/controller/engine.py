@@ -51,6 +51,7 @@ from tf_operator_tpu.controller.expectations import (
     expectation_key,
 )
 from tf_operator_tpu.runtime import metrics
+from tf_operator_tpu.runtime import retry as retry_mod
 from tf_operator_tpu.runtime.events import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING, Recorder
 from tf_operator_tpu.runtime.workqueue import RateLimitingQueue
 
@@ -179,7 +180,8 @@ class JobEngine:
                  expectations: Optional[ControllerExpectations] = None,
                  gang: Optional[GangScheduler] = None,
                  config: Optional[EngineConfig] = None,
-                 ckpt=None):
+                 ckpt=None,
+                 cp_health=None):
         self.plugin = plugin
         self.pod_control = pod_control
         self.endpoint_control = endpoint_control
@@ -194,6 +196,23 @@ class JobEngine:
         # restoredFromStep onto the status. None = no checkpoint fields
         # ever touched.
         self.ckpt = ckpt
+        # Optional ControlPlaneHealth (runtime/retry.py): control writes
+        # report success/failure into it (degraded-mode tracking), and
+        # each sync surfaces/clears the ControlPlaneDegraded condition.
+        # None = conditions never touched, writes fail un-tracked.
+        self.cp_health = cp_health
+        # In-place retry for transient control-write failures: a single
+        # 500 blip no longer aborts the whole sync; exhausted retries
+        # still raise into the workqueue's rate-limited requeue (the
+        # long-haul retry loop).
+        self.retry_policy = retry_mod.DEFAULT_POLICY
+
+    def _control_write(self, component: str, fn) -> None:
+        """Run a pod/endpoint control mutation with transient-failure
+        retries (runtime/retry.py), feeding degraded-mode tracking."""
+        retry_mod.with_retries(fn, policy=self.retry_policy,
+                               component=component,
+                               health=self.cp_health)
 
     # ------------------------------------------------------------------
     # Master reconcile (reference common/job.go:124-343)
@@ -328,6 +347,29 @@ class JobEngine:
         # below decides whether anything is written.
         if self.ckpt is not None:
             self.ckpt.sync_job_status(job)
+
+        # Degraded-mode surfacing (runtime/retry.py ControlPlaneHealth):
+        # while the API server has been failing past the threshold, the
+        # controller keeps reconciling but defers new drains/reclaims/
+        # preemptions — say so ON the job, level-triggered (the
+        # condition machinery no-ops on re-assert; the change diff
+        # below decides whether anything is written, and the write
+        # itself retries like any other — surfacing when the API server
+        # answers again is exactly when an operator reads it).
+        if self.cp_health is not None:
+            if self.cp_health.degraded:
+                cond.update_job_conditions(
+                    job.status, JobConditionType.CONTROLPLANE_DEGRADED,
+                    cond.JOB_CONTROLPLANE_DEGRADED_REASON,
+                    "The operator's API server has been unreachable "
+                    "past the degraded threshold; reconciling continues "
+                    "but new drains/reclaims/preemptions are deferred")
+            else:
+                cond.mark_condition_false(
+                    job.status, JobConditionType.CONTROLPLANE_DEGRADED,
+                    cond.JOB_CONTROLPLANE_RECOVERED_REASON,
+                    "The operator's API server is reachable again; "
+                    "disruptive actions resumed")
 
         for rtype, spec in replica_specs.items():
             self.reconcile_pods(job, pods, rtype, spec, replica_specs)
@@ -522,7 +564,10 @@ class JobEngine:
             self.gang.annotate_pod(job, pod, rt)
 
         try:
-            self.pod_control.create_pod(job.metadata.namespace, pod, job)
+            self._control_write(
+                "engine.create_pod",
+                lambda: self.pod_control.create_pod(
+                    job.metadata.namespace, pod, job))
         except Exception:
             # Roll back the expectation so the next sync retries
             # (reference pod.go:243-255).
@@ -533,8 +578,10 @@ class JobEngine:
         exp_key = expectation_key(job.key(), "pods", rt)
         self._expect(exp_key, dels=1)
         try:
-            self.pod_control.delete_pod(pod.metadata.namespace,
-                                        pod.metadata.name, job)
+            self._control_write(
+                "engine.delete_pod",
+                lambda: self.pod_control.delete_pod(
+                    pod.metadata.namespace, pod.metadata.name, job))
         except Exception:
             self.expectations.deletion_observed(exp_key)
             raise
@@ -587,8 +634,12 @@ class JobEngine:
                     exp_key = expectation_key(job.key(), "endpoints", rt)
                     self._expect(exp_key, dels=1)
                     try:
-                        self.endpoint_control.delete_endpoint(
-                            ep.metadata.namespace, ep.metadata.name, job)
+                        self._control_write(
+                            "engine.delete_endpoint",
+                            lambda ep=ep:
+                            self.endpoint_control.delete_endpoint(
+                                ep.metadata.namespace,
+                                ep.metadata.name, job))
                     except Exception:
                         self.expectations.deletion_observed(exp_key)
                         raise
@@ -633,7 +684,10 @@ class JobEngine:
         exp_key = expectation_key(job.key(), "endpoints", rt)
         self._expect(exp_key, adds=1)
         try:
-            self.endpoint_control.create_endpoint(job.metadata.namespace, ep, job)
+            self._control_write(
+                "engine.create_endpoint",
+                lambda: self.endpoint_control.create_endpoint(
+                    job.metadata.namespace, ep, job))
         except Exception:
             self.expectations.creation_observed(exp_key)
             raise
@@ -655,11 +709,15 @@ class JobEngine:
                     and pod.status.phase not in (PodPhase.RUNNING,
                                                  PodPhase.PENDING)):
                 continue
-            self.pod_control.delete_pod(pod.metadata.namespace,
-                                        pod.metadata.name, job)
+            self._control_write(
+                "engine.cleanup",
+                lambda pod=pod: self.pod_control.delete_pod(
+                    pod.metadata.namespace, pod.metadata.name, job))
             # Pod and endpoint share a name (reference job.go:41-44).
-            self.endpoint_control.delete_endpoint(pod.metadata.namespace,
-                                                  pod.metadata.name, job)
+            self._control_write(
+                "engine.cleanup",
+                lambda pod=pod: self.endpoint_control.delete_endpoint(
+                    pod.metadata.namespace, pod.metadata.name, job))
 
     def _cleanup_job_if_ttl(self, job: TPUJob) -> None:
         ttl = job.spec.run_policy.ttl_seconds_after_finished

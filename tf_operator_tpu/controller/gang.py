@@ -132,7 +132,8 @@ class SliceGangScheduler(GangScheduler):
                  domain_capacity_provider=None,
                  draining_provider=None,
                  quota=None,
-                 ckpt=None):
+                 ckpt=None,
+                 cp_health=None):
         if fairness not in ("backfill", "strict", "aged"):
             raise ValueError(f"unknown gang fairness {fairness!r}")
         self.store = store
@@ -171,6 +172,13 @@ class SliceGangScheduler(GangScheduler):
         # the gang acked a final save or the barrier timed out. None =
         # pre-coordinator eviction, byte-identical.
         self.ckpt = ckpt
+        # Optional ControlPlaneHealth (runtime/retry.py): while the API
+        # server is degraded, NEW disruptions — priority preemptions,
+        # quota-reclaim displacements — are deferred (a half-executed
+        # eviction against an unreachable apiserver is how chips get
+        # double-booked); completing already-started evictions is never
+        # gated. None = pre-degraded behavior, byte-identical.
+        self.cp_health = cp_health
         self.fairness = fairness
         self.aging_seconds = aging_seconds
         self.priority_classes = dict(priority_classes or {})
@@ -312,6 +320,13 @@ class SliceGangScheduler(GangScheduler):
         condition (engine.py) until the gang runs again."""
         group = self.store.try_get(store_mod.SLICEGROUPS, namespace, name)
         if group is None or group.status.phase == PHASE_PENDING:
+            return False
+        if (self.cp_health is not None
+                and not self.cp_health.allow_disruption("displace")):
+            # Degraded control plane: initiating a displacement now
+            # would open a checkpoint barrier (or delete pods) it may
+            # never be able to enforce; the caller's level-triggered
+            # pass retries once the API server answers again.
             return False
         if self.ckpt is not None and not self.ckpt.ready_to_evict(
                 namespace, name, reason):
@@ -590,10 +605,17 @@ class SliceGangScheduler(GangScheduler):
                     q_ok, q_borrow, q_why, q_terminal = qpass.evaluate(
                         group, need)
                 fits = fits_phys and q_ok
-                if not fits and self.preemption and q_ok and not fits_phys:
+                if (not fits and self.preemption and q_ok
+                        and not fits_phys
+                        and (self.cp_health is None
+                             or self.cp_health.allow_disruption(
+                                 "preemption"))):
                     # Priority preemption frees PHYSICAL capacity only —
                     # never fired to solve a quota block (that's the
-                    # quota manager's reclaim path).
+                    # quota manager's reclaim path). Deferred wholesale
+                    # while the control plane is degraded: choosing
+                    # victims it cannot reliably evict would strand
+                    # them Pending with chips double-booked.
                     fits, used, queue_used, ev_pending = self._try_preempt(
                         groups, group, need, pri, q, quota,
                         used, queue_used, reserved, now,
@@ -670,6 +692,12 @@ class SliceGangScheduler(GangScheduler):
                     qpass.finish()
                 except Exception:
                     log.exception("tenant-queue quota pass finish failed")
+            if (reclaims and self.cp_health is not None
+                    and not self.cp_health.allow_disruption("reclaim")):
+                # Degraded: the demands stay registered (level-triggered
+                # — the next pass re-derives them) but no borrower is
+                # displaced until evictions can actually be enforced.
+                reclaims = []
         # Pod deletes are API I/O on the kube backend — never under the
         # lock. Completed evictions free their chips on the next pass
         # (triggered by the pods' DELETED events re-enqueuing jobs);
@@ -879,16 +907,24 @@ class SliceGangScheduler(GangScheduler):
             # control (a store-level delete would only touch the kube
             # backend's informer mirror, not the cluster).
             job = TPUJob(metadata=ObjectMeta(name=name, namespace=ns))
+        from tf_operator_tpu.runtime import retry as retry_mod
+
         for pod in self._pods_occupying(ns, name):
             try:
                 # Both controls swallow NotFound themselves (deletion is
-                # level-triggered); anything else logs and retries next
-                # pass.
+                # level-triggered); transient blips retry in place
+                # (runtime/retry.py); anything that survives the
+                # backoff logs and retries next pass.
                 if self.pod_control is not None:
-                    self.pod_control.delete_pod(ns, pod.metadata.name, job)
+                    retry_mod.with_retries(
+                        lambda pod=pod: self.pod_control.delete_pod(
+                            ns, pod.metadata.name, job),
+                        component="gang.evict", health=self.cp_health)
                 else:
-                    self.store.try_delete(store_mod.PODS, ns,
-                                          pod.metadata.name)
+                    retry_mod.with_retries(
+                        lambda pod=pod: self.store.try_delete(
+                            store_mod.PODS, ns, pod.metadata.name),
+                        component="gang.evict", health=self.cp_health)
             except Exception as e:
                 log.warning("evicting pod %s/%s of preempted group %s "
                             "failed (will retry): %s",

@@ -156,12 +156,20 @@ class SliceHealthController:
                  namespace: Optional[str] = None,
                  default_grace_seconds: float = 0.0,
                  resync_seconds: float = 1.0,
-                 ckpt=None):
+                 ckpt=None,
+                 cp_health=None):
         self.store = store
         self.client = client
         self.gang = gang
         self.pod_control = pod_control
         self.recorder = recorder
+        # Optional ControlPlaneHealth (runtime/retry.py): while the API
+        # server is degraded, NEW drains are deferred — a drain started
+        # against an unreachable apiserver evicts pods it then cannot
+        # displace/rebind, the exact half-executed state the chaos
+        # invariants forbid. Cordons and signal classification continue
+        # (reads + an idempotent patch that simply retries).
+        self.cp_health = cp_health
         # Optional checkpoint coordinator (controller/ckpt.py): a drain
         # of a checkpointPolicy-enabled gang becomes save-then-evict —
         # the eviction waits (bounded by barrierTimeoutSeconds) for the
@@ -242,15 +250,25 @@ class SliceHealthController:
         self._observe_rebinds(degraded)
 
     def _cordon(self, node: Node, reason: str) -> None:
+        from tf_operator_tpu.runtime import retry as retry_mod
+
         name = node.metadata.name
-        try:
+
+        def write():
             if self.client is not None:
                 self.client.patch(store_mod.NODES, "", name,
                                   {"spec": {"unschedulable": True}})
             else:
-                node = node.deepcopy()
-                node.spec.unschedulable = True
-                self.store.update(store_mod.NODES, node)
+                fresh = node.deepcopy()
+                fresh.spec.unschedulable = True
+                self.store.update(store_mod.NODES, fresh)
+
+        try:
+            # Transient blips retry in place with backoff
+            # (runtime/retry.py); what survives logs and the next pass
+            # re-derives + retries level-triggered.
+            retry_mod.with_retries(write, component="health.cordon",
+                                   health=self.cp_health)
         except (store_mod.NotFoundError, store_mod.ConflictError):
             return  # node changed/vanished underneath; next pass retries
         except Exception as e:
@@ -300,6 +318,15 @@ class SliceHealthController:
                                  f"({', '.join(reasons)}); draining in "
                                  f"{grace:.0f}s unless they recover")
                 continue
+            if (self.cp_health is not None
+                    and not self.cp_health.allow_disruption("drain")):
+                # Degraded control plane: starting a drain now could
+                # evict pods and then fail to displace/rebind them —
+                # the half-executed state the invariants forbid. The
+                # signal persists, so the next healthy pass drains.
+                # Gated BEFORE ready_to_evict so no barrier is opened
+                # that the controller may not be able to enforce.
+                continue
             if self.ckpt is not None and not self.ckpt.ready_to_evict(
                     ns, name, f"node degraded ({', '.join(reasons)})"):
                 # Save-before-evict barrier in flight: the gang is
@@ -341,13 +368,24 @@ class SliceHealthController:
                 store_mod.PODS, namespace=ns,
                 selector={constants.LABEL_JOB_NAME: name})
             if p.status.phase not in _TERMINAL_POD_PHASES]
+        from tf_operator_tpu.runtime import retry as retry_mod
+
         for p in group_pods:
             try:
+                # Transient blips retry in place so one 500 mid-gang
+                # doesn't abort the atomic drain halfway through; an
+                # exhausted retry aborts the pass and the next one
+                # re-derives + retries with nothing double-counted.
                 if self.pod_control is not None:
-                    self.pod_control.delete_pod(ns, p.metadata.name, job)
+                    retry_mod.with_retries(
+                        lambda p=p: self.pod_control.delete_pod(
+                            ns, p.metadata.name, job),
+                        component="health.drain", health=self.cp_health)
                 else:
-                    self.store.try_delete(store_mod.PODS, ns,
-                                          p.metadata.name)
+                    retry_mod.with_retries(
+                        lambda p=p: self.store.try_delete(
+                            store_mod.PODS, ns, p.metadata.name),
+                        component="health.drain", health=self.cp_health)
             except Exception as e:
                 log.warning("draining pod %s/%s of gang %s failed "
                             "(will retry): %s", ns, p.metadata.name,

@@ -526,10 +526,29 @@ class TenantQueueManager:
                 log.debug("quota event emit failed", exc_info=True)
 
     def _update_status(self, kind: str, obj) -> None:
+        from tf_operator_tpu.runtime import retry as retry_mod
+
+        # Conflict-aware read-modify-write (runtime/retry.py): a CAS
+        # loss re-reads the queue and re-applies the computed status on
+        # fresh state instead of silently dropping the publication (a
+        # dropped status used to linger until the NEXT admission pass —
+        # under a conflict storm that meant dashboards reading stale
+        # pending/borrowed numbers indefinitely). A vanished queue or
+        # exhausted retries degrade to the old behavior: the next pass
+        # republishes.
+        desired = obj.status.deepcopy()
+
+        def apply(cur):
+            cur.status = desired.deepcopy()
+
         try:
-            self.store.update_status(kind, obj)
-        except (store_mod.ConflictError, store_mod.NotFoundError):
-            pass  # queue edited/deleted mid-pass; next pass republishes
+            retry_mod.update_with_conflict_retry(
+                self.store, kind, obj.metadata.namespace,
+                obj.metadata.name, apply, status=True,
+                component="quota.status")
+        except Exception:
+            log.debug("queue status publish failed; next pass "
+                      "republishes", exc_info=True)
 
 
 # ---------------------------------------------------------------------------

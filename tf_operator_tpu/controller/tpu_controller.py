@@ -125,7 +125,8 @@ class TPUJobController(JobPlugin):
                  config: Optional[EngineConfig] = None,
                  gang=None,
                  namespace: Optional[str] = None,
-                 ckpt=None):
+                 ckpt=None,
+                 cp_health=None):
         self.store = store
         self.recorder = recorder or Recorder()
         self.namespace = namespace  # None = all namespaces
@@ -135,6 +136,10 @@ class TPUJobController(JobPlugin):
         # restore-with-identity env into created pods and rolls the
         # barrier arc into job status (via the engine hook).
         self.ckpt = ckpt
+        # Optional ControlPlaneHealth (runtime/retry.py): write paths
+        # report outcomes into it; the engine surfaces degraded mode as
+        # a job condition; gang/health defer disruptions off it.
+        self.cp_health = cp_health
         self.engine = JobEngine(
             plugin=self,
             pod_control=StorePodControl(store, self.recorder),
@@ -145,6 +150,7 @@ class TPUJobController(JobPlugin):
             gang=gang,
             config=config,
             ckpt=ckpt,
+            cp_health=cp_health,
         )
         if gang is not None and getattr(gang, "pod_control", None) is None:
             # Preemption evicts victim pods through the same control the
@@ -512,10 +518,27 @@ class TPUJobController(JobPlugin):
                                      workqueue=self.workqueue)
 
     def update_job_status_in_api(self, job: TPUJob) -> None:
+        from tf_operator_tpu.runtime import retry as retry_mod
+
         try:
-            self.store.update_status(store_mod.TPUJOBS, job)
+            # Transient blips retry in place (the status write is the
+            # one mutation EVERY sync performs — losing it to a 500
+            # burst starves observers of conditions); NotFound means
+            # the job was deleted mid-sync. update_status carries no
+            # resourceVersion CAS here, but a fault-injecting store can
+            # still answer 409 — re-applying the same status is the
+            # correct RetryOnConflict body, so plain retry suffices.
+            retry_mod.with_retries(
+                lambda: self.store.update_status(store_mod.TPUJOBS, job),
+                component="controller.status",
+                retryable=lambda e: (retry_mod.is_transient(e)
+                                     or isinstance(
+                                         e, store_mod.ConflictError)),
+                health=self.cp_health)
         except store_mod.NotFoundError:
             pass  # job deleted mid-sync
+        except store_mod.ConflictError:
+            pass  # chaos-injected CAS loss; the next sync rewrites
 
     def set_cluster_spec(self, job: TPUJob, pod: Pod, rtype: str,
                          index: int) -> None:

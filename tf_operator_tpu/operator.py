@@ -49,9 +49,19 @@ class Operator:
                  queue_config: Optional[str] = None,
                  enable_ckpt_coordination: bool = False,
                  enable_slice_health: bool = False,
-                 health_drain_grace_seconds: float = 0.0):
+                 health_drain_grace_seconds: float = 0.0,
+                 degraded_after_seconds: float = 10.0):
+        from tf_operator_tpu.runtime.retry import ControlPlaneHealth
+
         self.store = store or Store()
         self.recorder = Recorder(sink=self._persist_event)
+        # Degraded-mode tracker (runtime/retry.py, docs/robustness.md):
+        # every subsystem's API writes report into it; past the
+        # threshold the controller keeps reconciling but defers NEW
+        # drains/reclaims/preemptions and stamps ControlPlaneDegraded
+        # on the jobs it syncs.
+        self.cp_health = ControlPlaneHealth(
+            threshold_seconds=degraded_after_seconds)
         config = config or EngineConfig()
         gang = None
         self.quota = None
@@ -93,11 +103,13 @@ class Operator:
                                       queue_quotas=gang_queue_quotas,
                                       preemption=gang_preemption,
                                       quota=self.quota,
-                                      ckpt=self.ckpt)
+                                      ckpt=self.ckpt,
+                                      cp_health=self.cp_health)
         self.controller = TPUJobController(self.store, recorder=self.recorder,
                                            config=config, gang=gang,
                                            namespace=namespace,
-                                           ckpt=self.ckpt)
+                                           ckpt=self.ckpt,
+                                           cp_health=self.cp_health)
         if self.ckpt is not None and gang is not None:
             # A barrier ack landing between resyncs must release the
             # held eviction promptly: record writes poke admission.
@@ -112,7 +124,7 @@ class Operator:
                 pod_control=self.controller.engine.pod_control,
                 recorder=self.recorder, namespace=namespace,
                 default_grace_seconds=health_drain_grace_seconds,
-                ckpt=self.ckpt)
+                ckpt=self.ckpt, cp_health=self.cp_health)
         self.backend = (LocalProcessBackend(self.store)
                         if backend is _DEFAULT_BACKEND else backend)
         if gang is not None and hasattr(self.backend,

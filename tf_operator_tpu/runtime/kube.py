@@ -1078,14 +1078,29 @@ class KubeJobController(TPUJobController):
         unset ones as explicit JSON nulls — because a merge patch can
         only CLEAR a field it names (RFC 7386): omitting a field leaves
         the server's old value in place forever."""
+        from tf_operator_tpu.runtime import retry as retry_mod
+
         body = job.status.to_dict(explicit_nulls=True)
         try:
-            self.client.patch(store_mod.TPUJOBS, job.metadata.namespace,
-                              job.metadata.name,
-                              {"status": body},
-                              subresource="status")
+            # Transient 5xx blips retry in place (runtime/retry.py) and
+            # report into degraded-mode tracking; a chaos-injected 409
+            # is retried too — the patch carries no resourceVersion
+            # precondition, so replaying the same merge is the correct
+            # RetryOnConflict body.
+            retry_mod.with_retries(
+                lambda: self.client.patch(
+                    store_mod.TPUJOBS, job.metadata.namespace,
+                    job.metadata.name, {"status": body},
+                    subresource="status"),
+                component="kube.status",
+                retryable=lambda e: (retry_mod.is_transient(e)
+                                     or isinstance(
+                                         e, store_mod.ConflictError)),
+                health=self.cp_health)
         except store_mod.NotFoundError:
             pass  # job deleted mid-sync
+        except store_mod.ConflictError:
+            pass  # injected CAS loss; the next sync rewrites
 
     def delete_job(self, job: TPUJob) -> None:
         try:
@@ -1181,12 +1196,20 @@ class KubeOperator:
                  slice_health: bool = True,
                  health_drain_grace_seconds: float = 0.0,
                  config: Optional[EngineConfig] = None,
-                 post_events: bool = True):
+                 post_events: bool = True,
+                 degraded_after_seconds: float = 10.0):
+        from tf_operator_tpu.runtime.retry import ControlPlaneHealth
+
         self.client = client
         self.store = Store()
         self.post_events = post_events
         recorder = Recorder(sink=self._post_event if post_events else None)
         config = config or EngineConfig()
+        # Degraded-mode tracker (runtime/retry.py, docs/robustness.md):
+        # API writes report into it; while degraded the controller keeps
+        # reconciling but defers new drains/reclaims/preemptions.
+        self.cp_health = ControlPlaneHealth(
+            threshold_seconds=degraded_after_seconds)
         gang = None
         if enable_gang_scheduling:
             config.enable_gang_scheduling = True
@@ -1222,10 +1245,12 @@ class KubeOperator:
                                           self._max_domain_chip_capacity
                                           if gang_binder
                                           and total_chips is None
-                                          else None))
+                                          else None),
+                                      cp_health=self.cp_health)
         self.controller = KubeJobController(client, store=self.store,
                                             recorder=recorder, config=config,
-                                            gang=gang, namespace=namespace)
+                                            gang=gang, namespace=namespace,
+                                            cp_health=self.cp_health)
         # Pods/services are watched UNSELECTED (upstream controller
         # style): a selector watch would drop an owned pod from the cache
         # the moment its group label is edited away, making it invisible
@@ -1259,7 +1284,8 @@ class KubeOperator:
                     self.store, client=client, gang=gang,
                     pod_control=self.controller.engine.pod_control,
                     recorder=recorder, namespace=namespace,
-                    default_grace_seconds=health_drain_grace_seconds)
+                    default_grace_seconds=health_drain_grace_seconds,
+                    cp_health=self.cp_health)
 
     def _cluster_chip_capacity(self) -> int:
         """Gang admission budget from live node inventory: allocatable

@@ -186,6 +186,40 @@ class FakeKubeState:
         # Fixed added latency per request (models a loaded production
         # apiserver; tens of ms is realistic).
         self.latency_seconds = 0.0
+        # --- seeded FaultProfile (runtime/chaos.py) -------------------
+        # The deterministic successor to the one-shot knobs above:
+        # per-verb/per-kind error RATES (write/read 5xx, 409 conflicts,
+        # timeouts/connection drops, stale reads, watch-stream deaths)
+        # drawn from one seeded RNG, so a whole chaos campaign is
+        # reproducible from its seed. None = no probabilistic faults;
+        # the counter knobs keep working either way (tests compose
+        # both). set_fault_profile() installs it.
+        self.fault_injector = None
+        # (resource, (ns, name)) -> previous stored object, feeding
+        # stale reads (a lagging watch-cache / follower-read analog).
+        self.object_history: Dict[Tuple[str, Tuple[str, str]], dict] = {}
+
+    def set_fault_profile(self, profile) -> "object":
+        """Install a seeded ``chaos.FaultProfile`` (None clears).
+        Returns the injector so tests can read its per-fault counts."""
+        if profile is None:
+            self.fault_injector = None
+            return None
+        from tf_operator_tpu.runtime.chaos import FaultInjector
+
+        self.fault_injector = FaultInjector(profile)
+        return self.fault_injector
+
+    def _remember(self, resource: str, key: Tuple[str, str]) -> None:
+        """Stash the current version before a mutation (stale-read
+        pool). Caller holds the lock."""
+        inj = self.fault_injector
+        if inj is None or inj.profile.rate("stale_read") <= 0.0:
+            return
+        cur = self.objects[resource].get(key)
+        if cur is not None:
+            self.object_history[(resource, key)] = json.loads(
+                json.dumps(cur))
 
     def next_rv(self) -> str:
         self._rv += 1
@@ -246,6 +280,12 @@ class FakeKubeState:
             if obj is None:
                 raise _HttpError(404, "NotFound",
                                  f"{resource} {ns}/{name} not found")
+            inj = self.fault_injector
+            if inj is not None and inj.decide("stale_read", "get",
+                                              resource):
+                stale = self.object_history.get((resource, (ns, name)))
+                if stale is not None:
+                    return json.loads(json.dumps(stale))
             return json.loads(json.dumps(obj))
 
     def delete(self, resource: str, ns: str, name: str) -> dict:
@@ -275,6 +315,7 @@ class FakeKubeState:
             if subresource == "status":
                 # Status subresource: only .status merges.
                 patch = {"status": patch.get("status")}
+            self._remember(resource, (ns, name))
             merged = merge_patch(cur, patch)
             meta = merged.setdefault("metadata", {})
             meta["name"], meta["namespace"] = name, ns
@@ -297,6 +338,7 @@ class FakeKubeState:
             if rv and rv != cur_rv:
                 raise _HttpError(409, "Conflict",
                                  f"resourceVersion {rv} != {cur_rv}")
+            self._remember(resource, (ns, name))
             obj = json.loads(json.dumps(obj))
             meta = obj.setdefault("metadata", {})
             meta["name"], meta["namespace"] = name, ns
@@ -559,14 +601,30 @@ class _Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as e:
             raise _HttpError(400, "Invalid", f"bad JSON: {e}")
 
+    def _request_verb_kind(self) -> Tuple[str, str]:
+        """(verb, resource) of the in-flight request, best-effort, for
+        FaultProfile rate lookup (routing proper happens later)."""
+        parts = [p for p in
+                 urllib.parse.urlsplit(self.path).path.split("/") if p]
+        resource = next((p for p in parts if p in RESOURCES), "*")
+        if "watch=1" in self.path or "watch=true" in self.path:
+            return "watch", resource
+        verb = {"GET": "get", "POST": "create", "PATCH": "patch",
+                "PUT": "update", "DELETE": "delete"}.get(
+                    self.command, "get")
+        if verb == "get" and parts and parts[-1] in RESOURCES:
+            verb = "list"
+        return verb, resource
+
     def _chaos_gate(self) -> bool:
-        """Apply injected latency / 429 / 5xx before routing. Returns
-        True when the request was consumed by an injected error. Watch
-        requests only pay latency (stream-level chaos has its own taps
-        in _serve_watch)."""
+        """Apply injected latency / 429 / 5xx / FaultProfile faults
+        before routing. Returns True when the request was consumed by
+        an injected error. Watch requests only pay latency
+        (stream-level chaos has its own taps in _serve_watch)."""
         import time as _time
 
         is_watch = "watch=1" in self.path or "watch=true" in self.path
+        verb, kind = self._request_verb_kind()
         with self.state.lock:
             delay = self.state.latency_seconds
             status = None
@@ -579,8 +637,33 @@ class _Handler(BaseHTTPRequestHandler):
                     self.state.inject_5xx -= 1
                     status = 500
             retry_after = self.state.retry_after_seconds
+            inj = self.state.fault_injector
         if delay:
             _time.sleep(delay)
+        if status is None and inj is not None and not is_watch:
+            # Seeded probabilistic faults (runtime/chaos.py). Order is
+            # meanest-first: a dropped connection beats a clean error
+            # body beats a conflict.
+            if inj.decide("timeout", verb, kind):
+                # No response at all: the client sees a reset/remote-
+                # disconnect and cannot know whether the server applied
+                # the write — exactly the ambiguity production retries
+                # must survive. (The request was consumed BEFORE
+                # routing, so nothing was applied here.)
+                self.close_connection = True
+                return True
+            mutating = verb in ("create", "patch", "update", "delete")
+            if mutating and inj.decide("conflict", verb, kind) \
+                    and verb in ("patch", "update"):
+                self._send_json(409, _status_body(
+                    409, "Conflict",
+                    "injected conflict: the object has been modified"))
+                return True
+            fault = "write_error" if mutating else "read_error"
+            if inj.decide(fault, verb, kind):
+                self._send_json(500, _status_body(
+                    500, "InternalError", "injected server error"))
+                return True
         if status == 429:
             body = json.dumps(_status_body(
                 429, "TooManyRequests", "throttled (injected)")).encode()
@@ -835,6 +918,16 @@ class _Handler(BaseHTTPRequestHandler):
                         self.state.reorder_events -= 1
                         held = (etype, obj)
                         continue  # delivered after the NEXT event
+                    inj = self.state.fault_injector
+                if inj is not None and inj.decide("watch_drop", "watch",
+                                                  resource):
+                    # Stream dies BEFORE this event is delivered (the
+                    # connection-drop analog): the client must
+                    # reconnect, and RV-resume replays everything from
+                    # its last delivered event — losing nothing iff the
+                    # reflector resumes correctly, which is the
+                    # property under test.
+                    return
                 line = json.dumps({"type": etype, "object": obj})
                 self.wfile.write(line.encode() + b"\n")
                 if held is not None:

@@ -96,6 +96,24 @@ class LeaderElector:
     # -- lock record CAS -------------------------------------------------
 
     def _try_acquire_or_renew(self) -> bool:
+        try:
+            return self._acquire_or_renew_once()
+        except Exception:
+            # ANY failure — a 5xx burst, a timeout, a dropped
+            # connection — is a failed attempt, never a thread-killer:
+            # before this guard, a transient error here propagated out
+            # of run(), silently killing the elector thread with
+            # _leading still set — a zombie leader that never renews,
+            # never steps down, and blocks standby failover until the
+            # humans notice (found by the injected-renew-failure tests,
+            # tests/test_leaderelection.py). The caller's retry loop +
+            # renew deadline turn persistent failure into a clean
+            # stepdown.
+            log.warning("lease acquire/renew attempt failed; retrying",
+                        exc_info=True)
+            return False
+
+    def _acquire_or_renew_once(self) -> bool:
         now = _now()
         lease = self.store.try_get(LEASES, self.namespace, self.name)
         if lease is None:
@@ -134,15 +152,19 @@ class LeaderElector:
             return False
 
     def release(self) -> None:
-        """Voluntarily drop the lease so a standby takes over instantly."""
-        lease = self.store.try_get(LEASES, self.namespace, self.name)
-        if lease is not None and lease.spec.holder_identity == self.identity:
-            lease.spec.holder_identity = ""
-            lease.spec.renew_time = None
-            try:
+        """Voluntarily drop the lease so a standby takes over instantly.
+        Best-effort: on any failure (including transport errors during
+        shutdown) the lease simply expires on its own duration."""
+        try:
+            lease = self.store.try_get(LEASES, self.namespace, self.name)
+            if (lease is not None
+                    and lease.spec.holder_identity == self.identity):
+                lease.spec.holder_identity = ""
+                lease.spec.renew_time = None
                 self.store.update(LEASES, lease)
-            except (store_mod.ConflictError, store_mod.NotFoundError):
-                pass
+        except Exception:
+            log.debug("lease release failed; it will expire on its own",
+                      exc_info=True)
 
     # -- run loop --------------------------------------------------------
 

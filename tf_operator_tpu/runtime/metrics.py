@@ -374,3 +374,32 @@ job_goodput_ratio = REGISTRY.gauge(
     "Fraction of a job's training steps NOT lost to disruptions: "
     "(progress - cumulative steps lost) / progress, 1.0 until the "
     "first loss", ["job_namespace", "job"])
+api_retries = REGISTRY.counter(
+    "tpu_operator_api_retries_total",
+    "In-place retries of transient API failures (runtime/retry.py "
+    "with_retries backoff), by the retrying component", ["component"])
+controlplane_degraded = REGISTRY.gauge(
+    "tpu_operator_controlplane_degraded",
+    "1 while the API server has been failing past the degraded-mode "
+    "threshold: the controller keeps reconciling but defers new "
+    "drains/reclaims/preemptions (docs/robustness.md)")
+degraded_entries = REGISTRY.counter(
+    "tpu_operator_controlplane_degraded_entries_total",
+    "Times the controller entered degraded mode (API server "
+    "unreachable past the threshold)")
+disruptions_deferred = REGISTRY.counter(
+    "tpu_operator_disruptions_deferred_total",
+    "Disruptive actions (drain/reclaim/preemption) NOT initiated "
+    "because the control plane was degraded", ["action"])
+store_watch_handler_errors = REGISTRY.counter(
+    "tpu_operator_store_watch_handler_errors_total",
+    "Exceptions raised by store watch handlers (swallowed so the "
+    "dispatcher survives; traceback logged once per handler)", ["kind"])
+bind_failures = REGISTRY.counter(
+    "tpu_operator_bind_failures_total",
+    "pods/binding POSTs that failed and will retry next binder pass, "
+    "by failure category", ["reason"])
+chaos_faults_injected = REGISTRY.counter(
+    "tpu_operator_chaos_faults_injected_total",
+    "Faults the chaos layer injected (runtime/chaos.py FaultProfile; "
+    "test/bench harnesses only — always 0 in production)", ["fault"])

@@ -24,10 +24,19 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
+from tf_operator_tpu.runtime import retry as retry_mod
 from tf_operator_tpu.runtime import store as store_mod
 from tf_operator_tpu.runtime.apiserver import WIRE_KINDS
 
 log = logging.getLogger("tpu_operator.remote")
+
+# Verbs safe to replay after an ambiguous failure: GET trivially;
+# PUT carries the object's resourceVersion (a replay after a landed
+# write loses the CAS -> ConflictError, which every caller handles);
+# DELETE replays to NotFound (level-triggered deletes handle it).
+# POST (create) is NOT replayed — a landed-then-lost create would
+# surface as a spurious AlreadyExists on objects the caller owns.
+_IDEMPOTENT_METHODS = ("GET", "PUT", "DELETE")
 
 _RECONNECT_DELAY = 0.5
 
@@ -188,25 +197,43 @@ class RemoteStore:
         if body is not None:
             data = json.dumps(body).encode()
             headers["Content-Type"] = "application/json"
-        try:
-            with self._open(url, self.timeout, data=data, method=method,
-                            headers=headers) as resp:
-                return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            payload = {}
+
+        def once() -> dict:
             try:
-                payload = json.loads(e.read() or b"{}")
-            except (ValueError, OSError):
-                pass
-            reason = payload.get("reason", "")
-            message = payload.get("message", str(e))
-            if reason == "NotFound":
-                raise store_mod.NotFoundError(message)
-            if reason == "AlreadyExists":
-                raise store_mod.AlreadyExistsError(message)
-            if reason == "Conflict":
-                raise store_mod.ConflictError(message)
-            raise RuntimeError(f"API error {e.code}: {message}")
+                with self._open(url, self.timeout, data=data,
+                                method=method, headers=headers) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                payload = {}
+                try:
+                    payload = json.loads(e.read() or b"{}")
+                except (ValueError, OSError):
+                    pass
+                reason = payload.get("reason", "")
+                message = payload.get("message", str(e))
+                if reason == "NotFound":
+                    raise store_mod.NotFoundError(message)
+                if reason == "AlreadyExists":
+                    raise store_mod.AlreadyExistsError(message)
+                if reason == "Conflict":
+                    raise store_mod.ConflictError(message)
+                if e.code == 429 or e.code >= 500:
+                    # Server blip/throttle: retryable (classified via
+                    # the shared transient taxonomy, runtime/retry.py).
+                    raise retry_mod.TransientAPIError(
+                        f"API error {e.code}: {message}", code=e.code)
+                raise RuntimeError(f"API error {e.code}: {message}")
+
+        if method in _IDEMPOTENT_METHODS:
+            # 5xx bursts, timeouts and dropped connections retry in
+            # place with capped-jittered backoff instead of surfacing
+            # straight to the SDK/agent caller; the scattered ad-hoc
+            # "except Exception: sleep and hope" sites this replaces
+            # never distinguished transient from semantic failures.
+            return retry_mod.with_retries(
+                once, policy=retry_mod.CLIENT_POLICY,
+                component="remote")
+        return once()
 
     @staticmethod
     def _cls(kind: str):

@@ -68,6 +68,7 @@ class Watcher:
         self.kind = kind
         self.handler = handler
         self.queue: "queue.Queue[Optional[Tuple[str, object]]]" = queue.Queue()
+        self.error_count = 0
         self.thread = threading.Thread(target=self._loop, daemon=True)
         self.thread.start()
 
@@ -80,10 +81,30 @@ class Watcher:
             try:
                 self.handler(event_type, obj)
             except Exception:  # watch handlers must never kill the dispatcher
+                # Swallowed-but-accounted: the traceback logs ONCE per
+                # handler (a broken handler throws on every event — one
+                # stack is diagnosis, thousands are log spam) and every
+                # occurrence lands in the
+                # store_watch_handler_errors_total{kind} metric so a
+                # silently-failing reconcile trigger is visible on a
+                # dashboard instead of only in drowned logs.
                 import logging
 
-                logging.getLogger("tpu_operator.store").exception(
-                    "watch handler error for %s", self.kind)
+                from tf_operator_tpu.runtime import metrics
+
+                self.error_count += 1
+                metrics.store_watch_handler_errors.inc(kind=self.kind)
+                logger = logging.getLogger("tpu_operator.store")
+                if self.error_count == 1:
+                    logger.exception(
+                        "watch handler error for %s (first occurrence; "
+                        "further ones are counted in "
+                        "store_watch_handler_errors_total and logged "
+                        "without traceback)", self.kind)
+                else:
+                    logger.warning(
+                        "watch handler error for %s (%d so far)",
+                        self.kind, self.error_count)
 
     def stop(self) -> None:
         # Deregister from the store first so _notify stops enqueueing
