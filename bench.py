@@ -132,20 +132,41 @@ def build_bench_step(batch_size: int, image_size: int,
 
 def bench_resnet50(batch_size: int, image_size: int, steps: int,
                    warmup: int, stem: str = "conv7",
-                   steps_per_call: int = 1):
+                   steps_per_call: int = 1,
+                   data_pipeline: bool = False):
     """``steps``/``warmup`` count optimizer steps; with
     ``steps_per_call > 1`` they are grouped into scan-fused dispatches
-    (steps must divide evenly)."""
+    (steps must divide evenly).
+
+    ``data_pipeline=True`` (env TPU_BENCH_DATA_PIPELINE=1; ROADMAP item
+    5, input-pipeline leg) feeds a FRESH host batch every step through
+    the async double-buffered prefetch (train/data.prefetch_to_device)
+    instead of the resident static batch — measuring the step as a real
+    training loop feeds it. Forces steps_per_call=1 (a scan-fused
+    dispatch consumes one resident batch by construction) and is a
+    different config_fingerprint: the two modes are not comparable."""
     assert steps % steps_per_call == 0 and warmup % steps_per_call == 0
     step, state, batch = build_bench_step(batch_size, image_size,
                                           stem=stem,
                                           steps_per_call=steps_per_call)
-    warmup //= steps_per_call
-    steps //= steps_per_call
-    batch_size *= steps_per_call  # images per dispatch
+    next_batch = lambda: batch
+    if data_pipeline:
+        assert steps_per_call == 1, "data_pipeline mode is per-step fed"
+        import jax
+
+        from tf_operator_tpu.train.data import (
+            images_pipeline,
+            prefetch_to_device,
+        )
+
+        dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        fed = prefetch_to_device(
+            images_pipeline(batch_size, image_size),
+            {"inputs": dev, "labels": dev}, depth=2)
+        next_batch = lambda: next(fed)  # noqa: E731
 
     for _ in range(warmup):
-        state, metrics = step(state, batch)
+        state, metrics = step(state, next_batch())
     float(metrics["loss"])  # host sync (block_until_ready can return early
     # on plugin backends whose buffers report ready before execution)
 
@@ -159,7 +180,7 @@ def bench_resnet50(batch_size: int, image_size: int, steps: int,
     def run_block() -> float:
         t0 = time.perf_counter()
         for _ in range(steps):
-            state_box[0], m = step(state_box[0], batch)
+            state_box[0], m = step(state_box[0], next_batch())
         float(m["loss"])
         return time.perf_counter() - t0
 
@@ -179,7 +200,7 @@ def bench_resnet50(batch_size: int, image_size: int, steps: int,
     t_med = sorted(times)[len(times) // 2]
     t0 = time.perf_counter()
     for _ in range(3 * steps):
-        state, metrics = step(state, batch)
+        state, metrics = step(state, next_batch())
     float(metrics["loss"])
     t_long = time.perf_counter() - t0
     per_step = (t_long - t_med) / (2 * steps)
@@ -223,9 +244,15 @@ def bench_config_fingerprint(config: dict) -> str:
 
 def main() -> int:
     try:
+        import os as _os
+
         import jax
 
         chip = detect_chip()
+        # Input-pipeline A/B (ROADMAP item 5): fresh prefetched batches
+        # per step instead of the resident static batch. Opt-in and
+        # fingerprint-changing — never silently alters the headline.
+        data_pipeline = _os.environ.get("TPU_BENCH_DATA_PIPELINE") == "1"
         if chip == "cpu":
             # CPU smoke run is not the benchmark config: report the
             # throughput but claim zero baseline credit.
@@ -233,8 +260,11 @@ def main() -> int:
                       "warmup": 1, "stem": "conv7", "steps_per_call": 1,
                       "spread_threshold": SPREAD_THRESHOLD,
                       "max_extra_reps": MAX_EXTRA_REPS}
-            imgs_per_sec, stats = bench_resnet50(batch_size=8, image_size=64,
-                                                 steps=3, warmup=1)
+            if data_pipeline:
+                config["data_pipeline"] = True
+            imgs_per_sec, stats = bench_resnet50(
+                batch_size=8, image_size=64, steps=3, warmup=1,
+                data_pipeline=data_pipeline)
             mfu = 0.0
         else:
             # Measured config (docs/benchmarks.md round-4 A/B table):
@@ -251,11 +281,15 @@ def main() -> int:
                       "warmup": 32, "stem": "s2d", "steps_per_call": 32,
                       "spread_threshold": SPREAD_THRESHOLD,
                       "max_extra_reps": MAX_EXTRA_REPS}
-            imgs_per_sec, stats = bench_resnet50(batch_size=256,
-                                                 image_size=224,
-                                                 steps=96, warmup=32,
-                                                 stem="s2d",
-                                                 steps_per_call=32)
+            if data_pipeline:
+                # Per-step fed mode cannot scan-fuse (one batch per
+                # dispatch); documented A/B config in docs/benchmarks.md.
+                config.update({"steps_per_call": 1, "data_pipeline": True})
+            imgs_per_sec, stats = bench_resnet50(
+                batch_size=256, image_size=224, steps=96, warmup=32,
+                stem="s2d",
+                steps_per_call=1 if data_pipeline else 32,
+                data_pipeline=data_pipeline)
             flops = imgs_per_sec * RESNET50_TRAIN_FLOPS_PER_IMAGE
             mfu = flops / PEAK_FLOPS[chip]
             if chip == "v5e":

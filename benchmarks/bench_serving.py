@@ -1,0 +1,172 @@
+"""Benchmark: serving-plane throughput and tail latency.
+
+Drives a synthetic request load through the in-process ServingEngine
+(request queue -> continuous batcher -> runner) and prints exactly ONE
+JSON line, bench.py conventions:
+
+    {"metric": "serving_tokens_per_sec[fake|llama-tiny]", "value": N,
+     "unit": "tokens/sec", "qps": ..., "ttft_p50_s": ..., "ttft_p99_s":
+     ..., "queue_depth_max": ..., "requests": ..., "completed": ...,
+     "rejected": ..., "env": {...}, "config_fingerprint": "..."}
+
+The p50/p99 TTFT come from the serving_ttft_seconds histogram via
+Histogram.quantile (runtime/metrics.py) — the same numbers a scrape +
+histogram_quantile() would produce. Arrivals are open-loop at --qps
+(deterministic inter-arrival jitter off a seed), split across --tenants
+weighted lanes, so queue_depth_max reflects genuine burst backpressure
+rather than lock-step submission.
+
+Runner "fake" is the deterministic jax-free generator (tier-1 smoke,
+pinned by tests/test_bench_serving.py); "llama" runs the real
+incremental-decode path on a tiny model.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+
+REPO = __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tf_operator_tpu.runtime import metrics  # noqa: E402
+from tf_operator_tpu.serve.batcher import (  # noqa: E402
+    ContinuousBatcher,
+    FakeRunner,
+)
+from tf_operator_tpu.serve.engine import ServingEngine  # noqa: E402
+from tf_operator_tpu.serve.queue import Request, RequestQueue  # noqa: E402
+
+
+def build_runner(kind: str, slots: int):
+    if kind == "fake":
+        return FakeRunner(max_slots=slots)
+    from tf_operator_tpu.serve.runner import LlamaRunner
+
+    return LlamaRunner(max_slots=slots)
+
+
+def bench_environment() -> dict:
+    """bench.py-style environment fingerprint; jax facts only when the
+    runner actually loaded jax (the fake runner must stay importable on
+    the slim install)."""
+    import platform as _plat
+
+    env = {"python": _plat.python_version()}
+    if "jax" in sys.modules:
+        import jax
+
+        d = jax.devices()[0]
+        env.update({"jax_version": jax.__version__,
+                    "platform": d.platform,
+                    "chip_kind": getattr(d, "device_kind", "")})
+    return env
+
+
+def config_fingerprint(config: dict) -> str:
+    import hashlib
+
+    return hashlib.sha1(
+        json.dumps(config, sort_keys=True).encode()).hexdigest()[:12]
+
+
+def run_bench(args) -> dict:
+    rng = random.Random(args.seed)
+    runner = build_runner(args.runner, args.slots)
+    tenants = [f"tenant-{i}" for i in range(args.tenants)]
+    # Staircase weights (1, 2, 3, ...): fairness under asymmetric quota,
+    # like ClusterQueue nominal chips would render them.
+    weights = {t: i + 1 for i, t in enumerate(tenants)}
+    queue = RequestQueue(max_depth=args.max_queue, tenant_weights=weights)
+    engine = ServingEngine(queue, ContinuousBatcher(runner))
+
+    metrics.REGISTRY.reset()
+    interval = 1.0 / args.qps if args.qps > 0 else 0.0
+    next_arrival = time.monotonic()
+    submitted = rejected = 0
+    queue_depth_max = 0
+    t0 = time.monotonic()
+    while submitted + rejected < args.requests or not engine.idle:
+        now = time.monotonic()
+        while (submitted + rejected < args.requests
+               and now >= next_arrival):
+            i = submitted + rejected
+            prompt_len = 1 + rng.randrange(args.max_prompt)
+            request = Request(
+                id=f"r{i:06d}", tenant=tenants[i % len(tenants)],
+                prompt=[rng.randrange(200) for _ in range(prompt_len)],
+                max_new_tokens=args.max_new_tokens)
+            if queue.submit(request):
+                submitted += 1
+            else:
+                rejected += 1
+            # Open-loop arrivals with +-50% jitter around 1/qps.
+            next_arrival += interval * (0.5 + rng.random())
+        queue_depth_max = max(queue_depth_max, queue.depth())
+        engine.step()
+        if engine.idle and submitted + rejected < args.requests:
+            sleep = max(0.0, next_arrival - time.monotonic())
+            if sleep:
+                time.sleep(min(sleep, 0.005))
+    elapsed = time.monotonic() - t0
+
+    p50 = metrics.serving_ttft_seconds.quantile(0.5)
+    p99 = metrics.serving_ttft_seconds.quantile(0.99)
+    config = {"runner": args.runner, "slots": args.slots,
+              "qps": args.qps, "requests": args.requests,
+              "tenants": args.tenants, "max_queue": args.max_queue,
+              "max_prompt": args.max_prompt,
+              "max_new_tokens": args.max_new_tokens, "seed": args.seed}
+    label = "fake" if args.runner == "fake" else "llama-tiny"
+    return {
+        "metric": f"serving_tokens_per_sec[{label}]",
+        "value": round(engine.tokens_total / elapsed, 2) if elapsed else 0.0,
+        "unit": "tokens/sec",
+        "qps": round(engine.completed_total / elapsed, 2) if elapsed else 0.0,
+        "ttft_p50_s": round(p50, 6) if p50 is not None else None,
+        "ttft_p99_s": round(p99, 6) if p99 is not None else None,
+        "queue_depth_max": queue_depth_max,
+        "requests": args.requests,
+        "completed": engine.completed_total,
+        "rejected": rejected,
+        "elapsed_s": round(elapsed, 3),
+        "env": bench_environment(),
+        "config_fingerprint": config_fingerprint(config),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--runner", default="fake",
+                        choices=("fake", "llama"))
+    parser.add_argument("--requests", type=int, default=400)
+    parser.add_argument("--qps", type=float, default=2000.0,
+                        help="open-loop arrival rate (0 = submit "
+                             "everything immediately)")
+    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--tenants", type=int, default=3)
+    parser.add_argument("--max-queue", type=int, default=256)
+    parser.add_argument("--max-prompt", type=int, default=12)
+    parser.add_argument("--max-new-tokens", type=int, default=16)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    try:
+        print(json.dumps(run_bench(args)))
+        return 0
+    except Exception as e:  # one JSON line, even on failure
+        print(json.dumps({
+            "metric": "serving_tokens_per_sec",
+            "value": 0.0,
+            "unit": "tokens/sec",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

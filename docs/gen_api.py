@@ -23,7 +23,8 @@ types (required containers, replica bounds, name formats) live in
 `tf_operator_tpu/api/validation.py`. The TenantQueue/ClusterQueue
 quota kinds (cohort semantics, borrowing, reclaim) are documented in
 `docs/quota.md`; the CheckpointRecord kind (the save-before-evict
-barrier's ack channel) in `docs/checkpoint.md`.
+barrier's ack channel) in `docs/checkpoint.md`; the `serving` replica
+role and ServingPolicy (online-inference gangs) in `docs/serving.md`.
 """
 
 

@@ -1,0 +1,70 @@
+"""bench_serving.py smoke: the harness runs at a tiny shape under
+tier-1 and the one-JSON-line artifact schema stays pinned (bench.py
+conventions — same reasoning as tests/test_bench_controlplane.py)."""
+
+import json
+import subprocess
+import sys
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks", "bench_serving.py")
+
+
+@pytest.fixture(scope="module")
+def artifact():
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--requests", "60", "--qps", "5000",
+         "--slots", "4", "--tenants", "2", "--seed", "7"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"exactly one JSON line, got: {proc.stdout!r}"
+    return json.loads(lines[0])
+
+
+def test_artifact_schema(artifact):
+    for key in ("metric", "value", "unit", "qps", "ttft_p50_s",
+                "ttft_p99_s", "queue_depth_max", "requests", "completed",
+                "rejected", "elapsed_s", "env", "config_fingerprint"):
+        assert key in artifact, f"missing {key}"
+    assert artifact["metric"] == "serving_tokens_per_sec[fake]"
+    assert artifact["unit"] == "tokens/sec"
+    assert isinstance(artifact["config_fingerprint"], str)
+    assert len(artifact["config_fingerprint"]) == 12
+
+
+def test_throughput_and_completion(artifact):
+    assert artifact["value"] > 0
+    assert artifact["completed"] + artifact["rejected"] == 60
+    assert artifact["completed"] > 0
+
+
+def test_ttft_quantiles_ordered(artifact):
+    # p99 >= p50 by construction of Histogram.quantile; both present
+    # when any request completed.
+    assert artifact["ttft_p50_s"] is not None
+    assert artifact["ttft_p99_s"] >= artifact["ttft_p50_s"]
+
+
+def test_fingerprint_tracks_config():
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--requests", "20", "--qps", "5000",
+         "--slots", "2", "--seed", "1"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    other = json.loads(proc.stdout.strip())
+    # Different shape -> different fingerprint: artifacts from distinct
+    # configs can never be median-compared by accident.
+    base = subprocess.run(
+        [sys.executable, BENCH, "--requests", "20", "--qps", "5000",
+         "--slots", "4", "--seed", "1"],
+        capture_output=True, text=True, timeout=120)
+    assert other["config_fingerprint"] != json.loads(
+        base.stdout.strip())["config_fingerprint"]
+
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

@@ -134,5 +134,74 @@ def test_pipelines_yield_trainer_format():
     assert set(batch) == {"inputs", "labels"}
     getattr(it, "close", lambda: None)()
 
+
+# --- async double-buffered host->device prefetch (ROADMAP item 5) -------
+
+def _device_sharding():
+    import jax
+
+    return jax.sharding.SingleDeviceSharding(jax.devices("cpu")[0])
+
+
+def test_prefetch_to_device_preserves_order_and_places():
+    import jax
+
+    from tf_operator_tpu.train.data import prefetch_to_device
+
+    src = [{"x": np.full((2,), i, np.int32)} for i in range(7)]
+    out = list(prefetch_to_device(iter(src), {"x": _device_sharding()},
+                                  depth=2))
+    assert [int(b["x"][0]) for b in out] == list(range(7))
+    assert all(isinstance(b["x"], jax.Array) for b in out)
+
+
+def test_prefetch_to_device_stays_one_ahead_not_greedy():
+    # Double buffering pulls at most `depth` batches beyond the one the
+    # consumer holds — it must never drain the source greedily (that
+    # would defeat backpressure and buffer the whole epoch on device).
+    from tf_operator_tpu.train.data import prefetch_to_device
+
+    pulled = []
+
+    def source():
+        for i in range(10):
+            pulled.append(i)
+            yield {"x": np.full((2,), i, np.int32)}
+
+    it = prefetch_to_device(source(), {"x": _device_sharding()}, depth=2)
+    next(it)
+    assert len(pulled) <= 4  # 1 consumed + <= depth+1 staged
+    next(it)
+    assert len(pulled) <= 5
+    assert sum(1 for _ in it) == 8  # remainder, in order, no loss
+
+
+def test_prefetch_to_device_short_iterator_and_empty():
+    from tf_operator_tpu.train.data import prefetch_to_device
+
+    sharding = {"x": _device_sharding()}
+    one = [{"x": np.zeros((1,), np.float32)}]
+    assert len(list(prefetch_to_device(iter(one), sharding, depth=4))) == 1
+    assert list(prefetch_to_device(iter([]), sharding, depth=2)) == []
+
+
+def test_run_train_steps_prefetch_flag_feeds_same_batches():
+    # Flag-guarded integration: run_train_steps(prefetch_sharding=...)
+    # must feed the exact same batch sequence as the unprefetched loop.
+    from tf_operator_tpu.train.trainer import run_train_steps
+
+    seen = []
+
+    def step_fn(state, batch):
+        seen.append(int(batch["x"][0]))
+        return state + 1, {"loss": 0.0}
+
+    src = [{"x": np.full((2,), i, np.int32)} for i in range(5)]
+    state = run_train_steps(step_fn, 0, iter(src), num_steps=5,
+                            prefetch_sharding={"x": _device_sharding()})
+    assert state == 5
+    assert seen == list(range(5))
+
+
 # CI shard (pyproject [tool.pytest.ini_options] markers)
 pytestmark = pytest.mark.compute

@@ -103,6 +103,59 @@ def test_histogram_timer():
     assert "dur_count 1" in r.render_text()
 
 
+# --- Histogram.quantile (serving SLO artifacts read p50/p99 locally) ----
+
+def test_quantile_empty_series_is_none():
+    h = Registry().histogram("q", "h", buckets=(1.0, 2.0))
+    assert h.quantile(0.5) is None
+
+
+def test_quantile_uniform_distribution_interpolates():
+    # 100 observations spread uniformly over (0, 10]: every decile of
+    # the data lands in a known bucket, and linear interpolation inside
+    # the bucket recovers the value to within one observation's width.
+    h = Registry().histogram("q", "h",
+                             buckets=(2.0, 4.0, 6.0, 8.0, 10.0))
+    for i in range(100):
+        h.observe((i + 1) * 0.1)  # 0.1 .. 10.0
+    assert h.quantile(0.0) == pytest.approx(0.0, abs=0.11)
+    assert h.quantile(0.5) == pytest.approx(5.0, abs=0.11)
+    assert h.quantile(0.9) == pytest.approx(9.0, abs=0.11)
+    assert h.quantile(1.0) == pytest.approx(10.0, abs=1e-9)
+
+
+def test_quantile_known_two_bucket_split():
+    # 3 obs <= 1.0, 1 obs in (1.0, 3.0]: p50 = rank 2 of 4 -> 2/3 into
+    # the first bucket; p99 = rank 3.96 -> 0.96 into the second.
+    h = Registry().histogram("q", "h", buckets=(1.0, 3.0))
+    for v in (0.2, 0.4, 0.9, 2.0):
+        h.observe(v)
+    assert h.quantile(0.5) == pytest.approx(2 / 3)
+    assert h.quantile(0.99) == pytest.approx(1.0 + 0.96 * 2.0)
+
+
+def test_quantile_overflow_bucket_clamps_to_highest_bound():
+    # Prometheus histogram_quantile convention: ranks in +Inf clamp to
+    # the highest finite bound — the histogram cannot resolve beyond it.
+    h = Registry().histogram("q", "h", buckets=(1.0, 5.0))
+    h.observe(0.5)
+    h.observe(100.0)
+    h.observe(200.0)
+    assert h.quantile(0.99) == 5.0
+    assert h.quantile(0.2) == pytest.approx(0.6)
+
+
+def test_quantile_labeled_series_are_independent():
+    r = Registry()
+    h = r.histogram("q", "h", ["t"], buckets=(1.0, 10.0))
+    h.observe(0.5, t="a")
+    h.observe(9.0, t="b")
+    assert h.quantile(1.0, t="a") <= 1.0
+    assert h.quantile(1.0, t="b") > 1.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5, t="a")
+
+
 # --- monitoring endpoint -------------------------------------------------
 
 @pytest.fixture()

@@ -32,6 +32,7 @@ from tf_operator_tpu.api.types import (  # noqa: F401
     RestartPolicy,
     RunPolicy,
     SchedulingPolicy,
+    ServingPolicy,
     SliceGroup,
     SliceGroupSpec,
     SuccessPolicy,
@@ -44,6 +45,7 @@ from tf_operator_tpu.api.types import (  # noqa: F401
     gen_general_name,
     is_chief_or_master,
     is_evaluator,
+    is_serving,
     is_worker,
 )
 from tf_operator_tpu.api.validation import (  # noqa: F401

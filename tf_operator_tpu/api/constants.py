@@ -103,3 +103,19 @@ ENV_CKPT_INTERVAL_STEPS = "TPUJOB_CKPT_INTERVAL_STEPS"
 ENV_CKPT_INTERVAL_SECONDS = "TPUJOB_CKPT_INTERVAL_SECONDS"
 ENV_CKPT_MAX_TO_KEEP = "TPUJOB_CKPT_MAX_TO_KEEP"
 ENV_RESTORE_STEP = "TPUJOB_RESTORE_STEP"
+
+# Env the controller renders from the job's ServingPolicy into
+# serving-role pods when --enable-serving is on (controller/serving.py;
+# without the flag the serving role is inert — pods run their command
+# with none of these set). Outside the bootstrap hash like the ENV_CKPT_*
+# family: a ServingPolicy edit or quota-weight change must not restart
+# live serving replicas mid-traffic.
+ENV_SERVE_SPOOL = "TPUJOB_SERVE_SPOOL"
+ENV_SERVE_SLOTS = "TPUJOB_SERVE_SLOTS"
+ENV_SERVE_MAX_QUEUE = "TPUJOB_SERVE_MAX_QUEUE"
+ENV_SERVE_MAX_TOKENS = "TPUJOB_SERVE_MAX_TOKENS"
+# 'tenant=weight,...' — the per-tenant QoS lane weights, derived from
+# the namespace's TenantQueues (weight = the backing ClusterQueue's
+# nominal chips), so request-level fair share follows the same handle
+# that decides chip fair share (docs/quota.md).
+ENV_SERVE_TENANT_WEIGHTS = "TPUJOB_SERVE_TENANT_WEIGHTS"

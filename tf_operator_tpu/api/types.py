@@ -47,8 +47,15 @@ class ReplicaType:
     WORKER = "worker"
     PS = "ps"
     EVALUATOR = "evaluator"
+    # TPU extension (tf_operator_tpu/serve, docs/serving.md): an online-
+    # inference replica. Holds chips like a worker (it runs the model's
+    # decode path on the slice) but never joins a jax.distributed world
+    # — each serving replica is an independent model server behind the
+    # shared request spool. No reference analog (TFJob had no serving
+    # workload kind).
+    SERVING = "serving"
 
-    ALL = (CHIEF, MASTER, WORKER, PS, EVALUATOR)
+    ALL = (CHIEF, MASTER, WORKER, PS, EVALUATOR, SERVING)
 
 
 def is_chief_or_master(rtype: str) -> bool:
@@ -62,6 +69,10 @@ def is_worker(rtype: str) -> bool:
 
 def is_evaluator(rtype: str) -> bool:
     return rtype.lower() == ReplicaType.EVALUATOR
+
+
+def is_serving(rtype: str) -> bool:
+    return rtype.lower() == ReplicaType.SERVING
 
 
 # ---------------------------------------------------------------------------
@@ -395,6 +406,46 @@ class CheckpointPolicy(ApiObject):
 
 
 @dataclasses.dataclass
+class ServingPolicy(ApiObject):
+    """Online-inference knobs for ``serving``-role replicas
+    (controller/serving.py renders them into pod env when
+    --enable-serving is on; tf_operator_tpu/serve consumes them).
+
+    No reference analog: TFJob orchestrated batch training only.
+
+    enabled:                opt this job's serving replicas into the
+                            serving plane (without it — or without the
+                            operator flag — the role is inert: pods run
+                            their command like any other replica type).
+    spool_directory:        shared request spool root (pending/claimed/
+                            done; docs/serving.md) every replica of the
+                            gang can reach.
+    max_batch_slots:        concurrent decode slots per replica (the KV
+                            cache's batch dimension).
+    max_queue_depth:        per-replica request-queue bound; submits
+                            beyond it are rejected, not buffered — the
+                            backpressure signal autoscaling reads off
+                            serving_queue_depth.
+    max_tokens_per_request: generation cap (prompt + output must fit
+                            the model's max_seq_len).
+    ttft_p99_slo_seconds:   optional p99 time-to-first-token target,
+                            recorded in bench/status artifacts next to
+                            the measured quantile (the operator never
+                            throttles on it).
+    tokens_per_second_slo:  optional per-replica decode-throughput
+                            target, same artifact-only semantics.
+    """
+
+    enabled: bool = False
+    spool_directory: str = ""
+    max_batch_slots: int = 8
+    max_queue_depth: int = 256
+    max_tokens_per_request: int = 64
+    ttft_p99_slo_seconds: Optional[float] = None
+    tokens_per_second_slo: Optional[float] = None
+
+
+@dataclasses.dataclass
 class RunPolicy(ApiObject):
     """Reference common/v1/types.go:107-148."""
 
@@ -408,6 +459,9 @@ class RunPolicy(ApiObject):
     # TPU extension: save-before-evict barriers + restore-with-identity
     # (controller/ckpt.py).
     checkpoint_policy: Optional[CheckpointPolicy] = None
+    # TPU extension: online-inference serving knobs for serving-role
+    # replicas (controller/serving.py, tf_operator_tpu/serve).
+    serving_policy: Optional[ServingPolicy] = None
 
 
 @dataclasses.dataclass

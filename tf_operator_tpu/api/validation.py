@@ -122,6 +122,32 @@ def _spec_errors(spec: TPUJobSpec):
             yield ("spec.runPolicy.checkpointPolicy.barrierTimeoutSeconds "
                    "must be > 0")
 
+    sp = spec.run_policy.serving_policy
+    if sp is not None:
+        if sp.enabled and not sp.spool_directory:
+            # Without a spool there is nowhere for requests to arrive or
+            # responses to land — an enabled policy would serve nothing.
+            yield ("spec.runPolicy.servingPolicy.spoolDirectory is "
+                   "required when the policy is enabled")
+        if sp.enabled and ReplicaType.SERVING not in spec.replica_specs:
+            yield ("spec.runPolicy.servingPolicy is enabled but the job "
+                   "declares no 'serving' replica type")
+        if sp.max_batch_slots < 1:
+            yield "spec.runPolicy.servingPolicy.maxBatchSlots must be >= 1"
+        if sp.max_queue_depth < 1:
+            yield "spec.runPolicy.servingPolicy.maxQueueDepth must be >= 1"
+        if sp.max_tokens_per_request < 1:
+            yield ("spec.runPolicy.servingPolicy.maxTokensPerRequest must "
+                   "be >= 1")
+        if (sp.ttft_p99_slo_seconds is not None
+                and sp.ttft_p99_slo_seconds <= 0):
+            yield ("spec.runPolicy.servingPolicy.ttftP99SloSeconds must "
+                   "be > 0")
+        if (sp.tokens_per_second_slo is not None
+                and sp.tokens_per_second_slo <= 0):
+            yield ("spec.runPolicy.servingPolicy.tokensPerSecondSlo must "
+                   "be > 0")
+
     if spec.queue_name and not _NAME_RE.match(spec.queue_name):
         yield (f"spec.queueName {spec.queue_name!r} must be a lowercase "
                "RFC-1123 label (alphanumerics and '-')")

@@ -134,6 +134,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "evict barriers, and rebinds restore from the "
                         "barrier-committed step (docs/checkpoint.md). "
                         "Off = eviction behavior identical to today")
+    p.add_argument("--enable-serving", action="store_true",
+                   help="wire the serving plane: jobs may declare a "
+                        "'serving' replica role whose pods get the "
+                        "runPolicy.servingPolicy knobs and per-tenant "
+                        "QoS lane weights rendered into their env "
+                        "(docs/serving.md); drains of serving gangs "
+                        "ride the save-before-evict barrier so "
+                        "in-flight requests re-queue instead of "
+                        "dropping. Off = the serving role is inert "
+                        "(controller behavior identical to today)")
     p.add_argument("--queue-config", default=None,
                    help="YAML/JSON file declaring clusterQueues / "
                         "tenantQueues to seed at startup (see "
@@ -265,7 +275,8 @@ class Server:
                                          False),
             queue_config=getattr(args, "queue_config", None),
             enable_ckpt_coordination=getattr(
-                args, "enable_ckpt_coordination", False))
+                args, "enable_ckpt_coordination", False),
+            enable_serving=getattr(args, "enable_serving", False))
         if getattr(args, "backend", "local") == "kube":
             # Cluster mode: the Store is the informer cache inside
             # KubeOperator; reads/writes/leases go to the K8s API.
@@ -471,6 +482,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.queue_config and not args.enable_tenant_queues:
         parser.error("--queue-config only makes sense with "
                      "--enable-tenant-queues")
+    if args.enable_serving and args.backend == "kube":
+        parser.error("--enable-serving is not yet supported with "
+                     "--backend kube (the serving worker's spool and "
+                     "notice-relay files need the node agent recorded "
+                     "in ROADMAP.md); use the local or served backend")
     if args.enable_ckpt_coordination and args.backend == "kube":
         parser.error("--enable-ckpt-coordination is not yet supported "
                      "with --backend kube (kubelet cannot relay the "

@@ -334,7 +334,10 @@ class CheckpointCoordinator:
                    if p.status.phase == "Running"
                    and p.metadata.labels.get(
                        constants.LABEL_REPLICA_TYPE, "").lower()
-                   == ReplicaType.WORKER}
+                   # Serving replicas gate like workers: their "save" is
+                   # re-spooling in-flight sequences (serve/worker.py) —
+                   # evicting before the ack drops live requests.
+                   in (ReplicaType.WORKER, ReplicaType.SERVING)}
         return barrier.stamped & (with_records | workers)
 
     def _complete(self, job: Optional[TPUJob], key: Tuple[str, str],

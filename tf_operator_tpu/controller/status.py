@@ -37,9 +37,10 @@ from tf_operator_tpu.runtime.workqueue import RateLimitingQueue
 
 log = logging.getLogger("tpu_operator.status")
 
-# Evaluation order (reference status.go:95-101).
+# Evaluation order (reference status.go:95-101; serving is a TPU
+# extension appended last so training-role semantics are untouched).
 _TYPE_ORDER = (ReplicaType.CHIEF, ReplicaType.EVALUATOR, ReplicaType.MASTER,
-               ReplicaType.PS, ReplicaType.WORKER)
+               ReplicaType.PS, ReplicaType.WORKER, ReplicaType.SERVING)
 
 
 def contains_chief_or_master(replica_specs: Dict[str, ReplicaSpec]) -> bool:
@@ -132,6 +133,16 @@ def update_job_status(job: TPUJob, replica_specs: Dict[str, ReplicaSpec],
                 if expected == 0 or (
                         worker0_completed
                         and job.spec.success_policy != SuccessPolicy.ALL_WORKERS):
+                    _set_succeeded(job, recorder)
+                elif running > 0:
+                    _set_running(job, recorder)
+            elif rtype == ReplicaType.SERVING:
+                # Serving replicas are long-running peers with no rank-0
+                # shortcut: the job Runs while any replica serves and
+                # Succeeds only when every replica exited 0 (the spool's
+                # close sentinel in bounded runs; production serving
+                # jobs simply never complete).
+                if expected == 0:
                     _set_succeeded(job, recorder)
                 elif running > 0:
                     _set_running(job, recorder)

@@ -126,7 +126,8 @@ class TPUJobController(JobPlugin):
                  gang=None,
                  namespace: Optional[str] = None,
                  ckpt=None,
-                 cp_health=None):
+                 cp_health=None,
+                 serving=None):
         self.store = store
         self.recorder = recorder or Recorder()
         self.namespace = namespace  # None = all namespaces
@@ -136,6 +137,10 @@ class TPUJobController(JobPlugin):
         # restore-with-identity env into created pods and rolls the
         # barrier arc into job status (via the engine hook).
         self.ckpt = ckpt
+        # Optional serving manager (controller/serving.py): renders
+        # ServingPolicy env into serving-role pods. None (the
+        # --enable-serving default) leaves the serving role inert.
+        self.serving = serving
         # Optional ControlPlaneHealth (runtime/retry.py): write paths
         # report outcomes into it; the engine surfaces degraded mode as
         # a job condition; gang/health defer disruptions off it.
@@ -556,8 +561,12 @@ class TPUJobController(JobPlugin):
         # reference had no topology to derive from; users hand-wrote
         # resources. Coordinator-only types (chief/ps/evaluator) hold no
         # chips (bootstrap/cluster.py:236-243).
-        if (job.spec.slice.accelerator
-                and rtype.lower() == ReplicaType.WORKER
+        # Serving replicas hold chips like workers: they run the model's
+        # decode path on the slice (chief/ps/evaluator remain
+        # coordinator-only, bootstrap/cluster.py:236-243).
+        chip_holder = rtype.lower() in (ReplicaType.WORKER,
+                                        ReplicaType.SERVING)
+        if (job.spec.slice.accelerator and chip_holder
                 and not any(constants.RESOURCE_TPU in c.resources
                             for c in pod.spec.containers)):
             from tf_operator_tpu.bootstrap.topology import parse_accelerator
@@ -567,8 +576,7 @@ class TPUJobController(JobPlugin):
                                      max(1, job.spec.slice.num_slices))
             container.resources[constants.RESOURCE_TPU] = str(
                 topo.devices_per_host)
-        if (job.spec.slice.accelerator
-                and rtype.lower() == ReplicaType.WORKER
+        if (job.spec.slice.accelerator and chip_holder
                 and not any(t.key == constants.RESOURCE_TPU
                             for t in pod.spec.tolerations)):
             # GKE TPU nodepools taint their nodes with the extended-
@@ -588,6 +596,13 @@ class TPUJobController(JobPlugin):
         # live pods.
         if self.ckpt is not None:
             container.env.update(self.ckpt.bootstrap_env(job))
+        # Serving env (controller/serving.py): ServingPolicy knobs +
+        # tenant QoS lane weights, rendered only for serving-role pods
+        # and only with --enable-serving — same outside-the-hash rule
+        # as the checkpoint env (a policy or quota-weight edit must not
+        # restart live serving replicas mid-traffic).
+        if self.serving is not None:
+            container.env.update(self.serving.bootstrap_env(job, rtype))
 
     def bootstrap_hash(self, job: TPUJob, rtype: str, index: int) -> str:
         """Cached world digest: the env render + sha1 is a pure function
@@ -638,12 +653,14 @@ class TPUJobController(JobPlugin):
             d.pop("task", None)
             if sparse:
                 (d.get("cluster") or {}).pop(ReplicaType.WORKER, None)
-            if rt in (ReplicaType.PS, ReplicaType.EVALUATOR):
+            if rt in (ReplicaType.PS, ReplicaType.EVALUATOR,
+                      ReplicaType.SERVING):
                 # Non-data-plane roles never DIAL the jax world through
                 # the spec (ps serves, workers dial it; bootstrap
                 # renders them no JAX_* env) — so a worker/chief resize
                 # must not restart them: a ps restart interrupts the
-                # whole job's parameter serving for nothing. Their
+                # whole job's parameter serving for nothing, and a
+                # serving restart drops live decode traffic. Their
                 # digest keeps the entries peers reach THEM by (their
                 # own role list) and drops the data-plane lists.
                 for t in (ReplicaType.CHIEF, ReplicaType.MASTER,

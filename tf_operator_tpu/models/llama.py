@@ -75,6 +75,16 @@ class LlamaConfig:
     # arranges when sp > 1).
     attention_impl: str = ""
     sp_axis: str = "sp"
+    # Incremental-decode mode (the serving plane, tf_operator_tpu/serve):
+    # attention reads/writes a causal KV cache ("cache" collection,
+    # [batch, kv_seq=max_seq_len, kv_heads, head_dim], constrained to
+    # the mesh via parallel/sharding.py logical axes) instead of
+    # recomputing the whole prefix. __call__ then REQUIRES per-token
+    # ``positions`` and the caller must thread the cache through
+    # ``mutable=["cache"]`` (see prefill/decode_step below). The param
+    # tree is identical to the training model's, so trained checkpoints
+    # load unchanged; remat is bypassed (no backward pass to trade for).
+    decode: bool = False
 
 
 def llama_3_8b() -> LlamaConfig:
@@ -92,7 +102,8 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, angles: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, angles: jax.Array,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         dense = lambda feats, name: nn.Dense(
             feats, use_bias=False, dtype=cfg.dtype,
@@ -107,8 +118,16 @@ class LlamaAttention(nn.Module):
 
         # RoPE on the global sequence view (GSPMD handles the sharding;
         # ring blocks only materialize inside the shard_map region below).
-        q = apply_rope(q, angles)
-        k = apply_rope(k, angles)
+        # ``positions`` ([B, S] absolute token positions) only on the
+        # decode path — each row rotates at its own sequence offset.
+        q = apply_rope(q, angles, positions)
+        k = apply_rope(k, angles, positions)
+        if cfg.decode:
+            if positions is None:
+                raise ValueError("decode mode requires positions")
+            return dense(cfg.hidden, "wo")(
+                self._cached_attention(q, k, v, positions)
+                .reshape(b, s, cfg.n_heads * cfg.head_dim))
         # Saveable under remat_policy="save_qkv": keeps the post-rope
         # projections across the remat boundary so the backward pass
         # skips the QKV matmuls + rope recompute (no-op otherwise).
@@ -167,6 +186,48 @@ class LlamaAttention(nn.Module):
         out = out.reshape(b, s, cfg.n_heads * cfg.head_dim)
         return dense(cfg.hidden, "wo")(out)
 
+    def _cached_attention(self, q: jax.Array, k: jax.Array, v: jax.Array,
+                          positions: jax.Array) -> jax.Array:
+        """Incremental attention against the causal KV cache.
+
+        The cache is [B, max_seq_len, n_kv_heads, head_dim] per block
+        ("cache" collection; the scan stacks a leading layers axis).
+        ``positions`` [B, S] are the absolute positions of this call's
+        tokens — consecutive per row by contract — so the new K/V land
+        at rows [positions[:,0], positions[:,0]+S) and a row attends
+        exactly the key positions <= its own. Rows past a sequence's
+        length are never attended (they are overwritten at the position
+        that first attends them), which is what makes slot reuse and
+        padded prefill safe for the continuous batcher (serve/batcher).
+        """
+        from tf_operator_tpu.parallel.sharding import LLAMA_RULES, constrain
+
+        cfg = self.config
+        b, s = q.shape[0], q.shape[1]
+        shape = (b, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim)
+        cache_k = self.variable("cache", "k", jnp.zeros, shape, cfg.dtype)
+        cache_v = self.variable("cache", "v", jnp.zeros, shape, cfg.dtype)
+        start = positions[:, 0]
+
+        def put(cache, new, p):
+            return jax.lax.dynamic_update_slice(cache, new, (p, 0, 0))
+
+        kv_axes = ("batch", "kv_seq", "kv_heads", "head_dim")
+        new_k = constrain(jax.vmap(put)(cache_k.value, k.astype(cfg.dtype),
+                                        start), kv_axes, LLAMA_RULES)
+        new_v = constrain(jax.vmap(put)(cache_v.value, v.astype(cfg.dtype),
+                                        start), kv_axes, LLAMA_RULES)
+        cache_k.value = new_k
+        cache_v.value = new_v
+
+        n_rep = cfg.n_heads // cfg.n_kv_heads
+        keys = repeat_kv(new_k, n_rep)
+        vals = repeat_kv(new_v, n_rep)
+        k_pos = jnp.arange(cfg.max_seq_len)
+        # [B, 1, S, T]: broadcasts over heads in attention()'s logits.
+        mask = k_pos[None, None, None, :] <= positions[:, None, :, None]
+        return attention(q, keys, vals, causal=False, mask=mask)
+
 
 class LlamaMLP(nn.Module):
     config: LlamaConfig
@@ -194,10 +255,11 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, angles: jax.Array
+    def __call__(self, x: jax.Array, angles: jax.Array,
+                 positions: Optional[jax.Array] = None
                  ) -> Tuple[jax.Array, None]:
         x = x + LlamaAttention(self.config, name="attn")(
-            RMSNorm(name="attn_norm")(x), angles)
+            RMSNorm(name="attn_norm")(x), angles, positions)
         x = x + LlamaMLP(self.config, name="mlp")(
             RMSNorm(name="mlp_norm")(x))
         return x, None
@@ -214,10 +276,11 @@ class LlamaBlockMlpRemat(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, angles: jax.Array
+    def __call__(self, x: jax.Array, angles: jax.Array,
+                 positions: Optional[jax.Array] = None
                  ) -> Tuple[jax.Array, None]:
         x = x + LlamaAttention(self.config, name="attn")(
-            RMSNorm(name="attn_norm")(x), angles)
+            RMSNorm(name="attn_norm")(x), angles, positions)
         mlp = nn.remat(LlamaMLP, prevent_cse=False)
         x = x + mlp(self.config, name="mlp")(
             RMSNorm(name="mlp_norm")(x))
@@ -228,7 +291,8 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
+    def __call__(self, tokens: jax.Array,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
                      param_dtype=jnp.float32, name="embed_tokens")(tokens)
@@ -236,7 +300,7 @@ class Llama(nn.Module):
                                   cfg.rope_theta)
 
         block = LlamaBlock
-        if cfg.remat:
+        if cfg.remat and not cfg.decode:
             if cfg.remat_policy == "mlp_only":
                 block = LlamaBlockMlpRemat
             elif cfg.remat_policy in ("save_attn", "save_qkv"):
@@ -253,15 +317,23 @@ class Llama(nn.Module):
                     "full | save_attn | save_qkv | mlp_only")
             else:
                 block = nn.remat(block, prevent_cse=False)
+        variable_axes = {"params": 0}
+        if cfg.decode:
+            # Per-block KV caches stack on a leading layers axis, like
+            # the scanned params.
+            variable_axes["cache"] = 0
         ScanBlocks = nn.scan(
             block,
-            variable_axes={"params": 0},
+            variable_axes=variable_axes,
             split_rngs={"params": True},
             in_axes=nn.broadcast,
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        x, _ = ScanBlocks(cfg, name="blocks")(x, angles)
+        if positions is None:
+            x, _ = ScanBlocks(cfg, name="blocks")(x, angles)
+        else:
+            x, _ = ScanBlocks(cfg, name="blocks")(x, angles, positions)
 
         x = RMSNorm(name="final_norm")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
@@ -301,3 +373,71 @@ def param_logical_axes(path: Tuple[str, ...], value) -> Tuple[Optional[str], ...
             break
     raise ValueError(f"no logical axes for param {'/'.join(path)} "
                      f"shape {getattr(value, 'shape', '?')}")
+
+
+# ---------------------------------------------------------------------------
+# Incremental decode (serving plane). The KV cache is an explicit pytree
+# threaded through flax's mutable-collection mechanism so the caller (the
+# continuous batcher) owns slot management:
+#
+#   model  = Llama(dataclasses.replace(cfg, decode=True))   # same params
+#   cache  = init_cache(model, params, batch_size=slots)
+#   logits, c1 = prefill(model, params, one_cache, prompt, positions)
+#   cache  = insert_cache(cache, c1, slot)                  # slot admission
+#   logits, cache = decode_step(model, params, cache, tok, positions)
+#
+# All four are jittable (positions/slot may be traced). Cache leaves are
+# [layers, batch, kv_seq, kv_heads, head_dim]; inside the model each
+# block constrains its slice to the mesh via the kv_heads/kv_seq logical
+# axes (parallel/sharding.py LLAMA_RULES), so tp shards cache heads
+# exactly like the attention weights.
+# ---------------------------------------------------------------------------
+
+
+def init_cache(model: "Llama", params, batch_size: int):
+    """All-zeros KV cache pytree for ``batch_size`` concurrent slots.
+
+    Built from ``eval_shape`` (never a traced dummy forward), so no
+    garbage key/value ever enters the cache: a slot's rows are only ever
+    written by prefill/decode_step at the positions that later attend
+    them."""
+    tokens = jnp.zeros((batch_size, 1), jnp.int32)
+    positions = jnp.zeros((batch_size, 1), jnp.int32)
+    _, variables = jax.eval_shape(
+        lambda p, t, pos: model.apply({"params": p}, t, positions=pos,
+                                      mutable=["cache"]),
+        params, tokens, positions)
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                        variables["cache"])
+
+
+def prefill(model: "Llama", params, cache, tokens: jax.Array,
+            positions: jax.Array):
+    """One incremental-decode forward: returns (logits, updated cache).
+
+    ``tokens``/``positions`` are [B, S]; each row's positions must be
+    consecutive (its KV rows land at [positions[i,0],
+    positions[i,0]+S)). Prompt processing uses S = prompt length (pad
+    tails are harmless — see LlamaAttention._cached_attention); decoding
+    is the same call at S = 1."""
+    logits, variables = model.apply({"params": params, "cache": cache},
+                                    tokens, positions=positions,
+                                    mutable=["cache"])
+    return logits, variables["cache"]
+
+
+def decode_step(model: "Llama", params, cache, tokens: jax.Array,
+                positions: jax.Array):
+    """One token per row: ``prefill`` at S = 1 (separate name so call
+    sites read as the phase they implement)."""
+    return prefill(model, params, cache, tokens, positions)
+
+
+def insert_cache(cache, one, slot):
+    """Write a 1-row cache (a finished prefill) into ``slot`` of the
+    decode cache — the continuous batcher's slot-admission primitive.
+    ``slot`` may be traced; leaves are [layers, batch, kv_seq, ...], so
+    the batch axis is 1."""
+    return jax.tree.map(
+        lambda c, o: jax.lax.dynamic_update_slice_in_dim(
+            c, o.astype(c.dtype), slot, axis=1), cache, one)

@@ -48,6 +48,7 @@ class Operator:
                  enable_tenant_queues: bool = False,
                  queue_config: Optional[str] = None,
                  enable_ckpt_coordination: bool = False,
+                 enable_serving: bool = False,
                  enable_slice_health: bool = False,
                  health_drain_grace_seconds: float = 0.0,
                  degraded_after_seconds: float = 10.0):
@@ -83,6 +84,16 @@ class Operator:
             self.ckpt = CheckpointCoordinator(self.store,
                                               recorder=self.recorder,
                                               namespace=namespace)
+        self.serving = None
+        if enable_serving:
+            from tf_operator_tpu.controller.serving import ServingManager
+
+            # Serving-plane wiring (controller/serving.py): renders
+            # ServingPolicy + tenant QoS lane weights into serving-role
+            # pods. Off = the serving role stays inert (flag-off parity).
+            self.serving = ServingManager(self.store,
+                                          recorder=self.recorder,
+                                          namespace=namespace)
         if enable_gang_scheduling:
             config.enable_gang_scheduling = True
             if enable_tenant_queues:
@@ -109,7 +120,8 @@ class Operator:
                                            config=config, gang=gang,
                                            namespace=namespace,
                                            ckpt=self.ckpt,
-                                           cp_health=self.cp_health)
+                                           cp_health=self.cp_health,
+                                           serving=self.serving)
         if self.ckpt is not None and gang is not None:
             # A barrier ack landing between resyncs must release the
             # held eviction promptly: record writes poke admission.

@@ -35,15 +35,16 @@ def rope_frequencies(head_dim: int, max_seq_len: int,
 def apply_rope(x: jax.Array, angles: jax.Array,
                positions: Optional[jax.Array] = None) -> jax.Array:
     """Rotate [..., S, H, D] by position. ``angles`` is [max_S, D/2];
-    ``positions`` ([..., S]) defaults to arange."""
+    ``positions`` ([..., S], e.g. [S] or [B, S] for per-row offsets on
+    the decode path) defaults to arange."""
     seq_len = x.shape[-3]
     if positions is None:
         freqs = angles[:seq_len]  # [S, D/2]
     else:
         freqs = angles[positions]  # [..., S, D/2]
-        freqs = jnp.expand_dims(freqs, axis=-2) if freqs.ndim == x.ndim - 1 \
-            else freqs
-    cos = jnp.cos(freqs)[..., :, None, :]  # [..., S, 1, D/2]
+    # [..., S, 1, D/2]: the inserted head axis broadcasts against H for
+    # both the [S, D/2] and per-row [B, S, D/2] shapes.
+    cos = jnp.cos(freqs)[..., :, None, :]
     sin = jnp.sin(freqs)[..., :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)

@@ -134,6 +134,37 @@ class Histogram(_Metric):
         with self._lock:
             return self._totals.get(self._key(labels), 0)
 
+    def quantile(self, q: float, **labels: str) -> Optional[float]:
+        """The ``q``-quantile (0 <= q <= 1) interpolated from the
+        cumulative bucket counts — Prometheus ``histogram_quantile``
+        semantics, computed locally so status/bench artifacts can report
+        p50/p99 without a scrape+PromQL round trip:
+
+        - linear interpolation inside the bucket the target rank lands
+          in (lower bound = previous bucket's upper bound, 0.0 for the
+          first bucket);
+        - ranks falling in the +Inf overflow bucket clamp to the highest
+          finite bound (the histogram cannot resolve beyond it);
+        - None when the series has no observations.
+        """
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        key = self._key(labels)
+        with self._lock:
+            total = self._totals.get(key, 0)
+            if total == 0:
+                return None
+            counts = list(self._counts.get(key, ()))
+        target = q * total
+        prev_cum, lo = 0, 0.0
+        for cum, hi in zip(counts, self.buckets):
+            if cum >= target:
+                in_bucket = cum - prev_cum
+                frac = ((target - prev_cum) / in_bucket) if in_bucket else 1.0
+                return lo + frac * (hi - lo)
+            prev_cum, lo = cum, hi
+        return float(self.buckets[-1])
+
     def render(self) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} histogram"]
@@ -403,3 +434,28 @@ chaos_faults_injected = REGISTRY.counter(
     "tpu_operator_chaos_faults_injected_total",
     "Faults the chaos layer injected (runtime/chaos.py FaultProfile; "
     "test/bench harnesses only — always 0 in production)", ["fault"])
+
+# --- serving plane (tf_operator_tpu/serve; docs/serving.md SLO catalog).
+# Observed by the ServingEngine in whichever process runs it: each
+# serving replica exposes its own /metrics in production; benchmarks and
+# in-process tests read the ambient registry directly.
+serving_tokens_per_second = REGISTRY.gauge(
+    "tpu_operator_serving_tokens_per_second",
+    "Decode throughput of this serving replica over the last engine "
+    "step window (generated tokens only; prompt tokens excluded)")
+serving_ttft_seconds = REGISTRY.histogram(
+    "tpu_operator_serving_ttft_seconds",
+    "Time to first token: request enqueue to the prefill that emitted "
+    "its first generated token (the serving SLO's head latency; p50/p99 "
+    "via Histogram.quantile)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0))
+serving_queue_depth = REGISTRY.gauge(
+    "tpu_operator_serving_queue_depth",
+    "Requests waiting in a tenant's QoS lane of the serving request "
+    "queue (admitted-to-slot requests excluded)", ["tenant"])
+serving_requests_total = REGISTRY.counter(
+    "tpu_operator_serving_requests_total",
+    "Serving requests by terminal outcome: completed (response "
+    "emitted), rejected (queue full at submit), requeued (drained "
+    "mid-flight back to the spool for another replica)", ["outcome"])

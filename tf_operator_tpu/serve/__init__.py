@@ -1,0 +1,18 @@
+"""Online-inference serving plane (docs/serving.md).
+
+The second half of the product next to batch training: a request queue
+with per-tenant QoS lanes (``queue``), slot-based continuous batching
+over the llama incremental-decode path (``batcher``/``runner``), the
+loop that ties them together and publishes SLO metrics (``engine``),
+and the spool-backed serving worker process the local backend runs as
+``serving``-role pods (``worker``). Control-plane wiring (the
+``serving`` replica role, ServingPolicy, drain-mid-traffic semantics)
+lives in api/types.py + controller/serving.py.
+"""
+
+from tf_operator_tpu.serve.queue import Request, RequestQueue  # noqa: F401
+from tf_operator_tpu.serve.batcher import (  # noqa: F401
+    ContinuousBatcher,
+    FakeRunner,
+)
+from tf_operator_tpu.serve.engine import ServingEngine  # noqa: F401

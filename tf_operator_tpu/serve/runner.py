@@ -1,0 +1,112 @@
+"""LlamaRunner: the continuous batcher's prefill/decode phases on the
+real model (models/llama.py incremental-decode path).
+
+Phase split and compile behavior:
+
+- ``prefill`` runs one request at a time on a single-row cache, padded
+  to a power-of-two bucket so the number of distinct XLA programs is
+  O(log max_seq_len), then inserts the row into the request's slot of
+  the shared decode cache (``insert_cache``; the slot index is traced,
+  so admission never recompiles).
+- ``decode`` is ONE jitted program at the fixed [max_slots, 1] shape,
+  every step, regardless of how many slots are occupied — free slots
+  decode garbage rows that are overwritten before any real sequence can
+  attend them (see LlamaAttention._cached_attention).
+
+Run it under ``parallel.mesh.use_mesh`` to shard: the cache constrains
+itself to the mesh via the kv_heads/kv_seq logical axes, so tp splits
+cache heads exactly like the attention weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from tf_operator_tpu.serve.batcher import Runner
+
+
+class LlamaRunner(Runner):
+    def __init__(self, config=None, params=None, max_slots: int = 4,
+                 rng_seed: int = 0, eos: Optional[int] = None,
+                 min_prefill_bucket: int = 8):
+        import jax
+        import jax.numpy as jnp
+
+        from tf_operator_tpu.models.llama import (
+            Llama,
+            decode_step,
+            init_cache,
+            insert_cache,
+            llama_tiny,
+            prefill,
+        )
+
+        self._jnp = jnp
+        cfg = config or llama_tiny()
+        self.config = dataclasses.replace(cfg, decode=True)
+        self.model = Llama(self.config)
+        self.max_slots = max_slots
+        self.eos = eos
+        self.min_prefill_bucket = min_prefill_bucket
+        if params is None:
+            dummy = jnp.zeros((1, 1), jnp.int32)
+            params = self.model.init(jax.random.PRNGKey(rng_seed), dummy,
+                                     positions=dummy)["params"]
+        self.params = params
+        self.cache = init_cache(self.model, params, max_slots)
+        # Single-row staging cache, reused across prefills: stale rows
+        # past the new prompt's length are never attended before being
+        # overwritten, so no zeroing between requests.
+        self._stage = init_cache(self.model, params, 1)
+        model = self.model
+        self._prefill_fn = jax.jit(
+            lambda p, c, t, pos: prefill(model, p, c, t, pos))
+        self._decode_fn = jax.jit(
+            lambda p, c, t, pos: decode_step(model, p, c, t, pos))
+        self._insert_fn = jax.jit(insert_cache)
+
+    def _bucket(self, n: int) -> int:
+        size = self.min_prefill_bucket
+        while size < n:
+            size *= 2
+        return min(size, self.config.max_seq_len)
+
+    def prefill(self, prompt: List[int], slot: int) -> int:
+        jnp = self._jnp
+        length = len(prompt)
+        if not 0 < length + 1 < self.config.max_seq_len:
+            raise ValueError(f"prompt length {length} outside "
+                             f"(0, {self.config.max_seq_len - 1})")
+        size = self._bucket(length)
+        tokens = jnp.zeros((1, size), jnp.int32).at[0, :length].set(
+            jnp.asarray(prompt, jnp.int32))
+        positions = jnp.arange(size, dtype=jnp.int32)[None, :]
+        logits, self._stage = self._prefill_fn(self.params, self._stage,
+                                               tokens, positions)
+        self.cache = self._insert_fn(self.cache, self._stage,
+                                     jnp.int32(slot))
+        return int(jnp.argmax(logits[0, length - 1].astype(jnp.float32)))
+
+    def decode(self, last_tokens: List[Optional[int]],
+               lengths: List[Optional[int]]) -> List[Optional[int]]:
+        jnp = self._jnp
+        tokens = [0] * self.max_slots
+        positions = [0] * self.max_slots
+        active = []
+        for slot in range(self.max_slots):
+            if slot < len(lengths) and lengths[slot] is not None:
+                # The fed token is the newest generated one; its
+                # position is length-1 (length counts prompt + output).
+                tokens[slot] = int(last_tokens[slot])
+                positions[slot] = int(lengths[slot]) - 1
+                active.append(slot)
+        logits, self.cache = self._decode_fn(
+            self.params, self.cache,
+            jnp.asarray(tokens, jnp.int32)[:, None],
+            jnp.asarray(positions, jnp.int32)[:, None])
+        best = jnp.argmax(logits[:, 0].astype(jnp.float32), axis=-1)
+        out: List[Optional[int]] = [None] * self.max_slots
+        for slot in active:
+            out[slot] = int(best[slot])
+        return out

@@ -162,6 +162,46 @@ class DeviceFeeder:
             pass
 
 
+def prefetch_to_device(it: Iterator, sharding_tree,
+                       depth: int = 2) -> Iterator:
+    """Async double-buffered host→device prefetch (ROADMAP item 5,
+    first leg): keep ``depth`` batches in flight on the device so the
+    host→HBM transfer of batch N+1 overlaps the compute consuming
+    batch N.
+
+    Unlike ``DeviceFeeder`` there is no thread: ``jax.device_put`` is
+    asynchronous (it returns as soon as the transfer is enqueued), so a
+    small on-device ring is enough — the flax ``prefetch_to_device``
+    pattern. The consumer must actually USE each yielded batch before
+    pulling the next, which every training loop does. ``depth=2`` is
+    classic double buffering; deeper helps only when batch production
+    jitter exceeds one step time. Flag-guarded at the call sites
+    (trainer.run_train_steps ``prefetch_sharding``, bench.py
+    TPU_BENCH_DATA_PIPELINE) — default behavior is unchanged.
+    """
+    from collections import deque
+
+    it = iter(it)
+    buf: deque = deque()
+
+    def stage(batch):
+        return jax.tree.map(lambda x, s: jax.device_put(x, s), batch,
+                            sharding_tree)
+
+    try:
+        for _ in range(max(1, depth)):
+            buf.append(stage(next(it)))
+    except StopIteration:
+        pass
+    while buf:
+        out = buf.popleft()
+        try:
+            buf.append(stage(next(it)))
+        except StopIteration:
+            pass
+        yield out
+
+
 def multihost_batch(local_batch: Dict[str, np.ndarray],
                     sharding_tree) -> Dict[str, jax.Array]:
     """Assemble a global sharded batch from this process's local shard

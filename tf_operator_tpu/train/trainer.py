@@ -241,7 +241,8 @@ class Trainer:
 
 def run_train_steps(step_fn, state, batch_iter, num_steps: int,
                     start_step: int = 0, ckpt_hook=None,
-                    on_metrics: Optional[Callable] = None):
+                    on_metrics: Optional[Callable] = None,
+                    prefetch_sharding=None, prefetch_depth: int = 2):
     """Drive ``num_steps`` optimizer steps through a compiled step
     function, threading the coordinated-checkpoint hook
     (train/checkpoint.py CheckpointHook) after every step — the loop
@@ -253,7 +254,19 @@ def run_train_steps(step_fn, state, batch_iter, num_steps: int,
     the gang, and periodic cadence saves run between disruptions. The
     step counter is a plain Python int anchored at ``start_step`` (the
     restored step), so checkpoint cadence never forces a device sync.
+
+    ``prefetch_sharding`` (a sharding pytree mirroring the batch, e.g.
+    ``Trainer.batch_shardings(sample)``) opts the loop into async
+    double-buffered host→device prefetch (train/data.py
+    ``prefetch_to_device``): batch N+1's transfer overlaps step N's
+    compute. Off by default — the input pipeline is byte-identical
+    without it.
     """
+    if prefetch_sharding is not None:
+        from tf_operator_tpu.train.data import prefetch_to_device
+
+        batch_iter = prefetch_to_device(batch_iter, prefetch_sharding,
+                                        depth=prefetch_depth)
     step = start_step
     for _ in range(num_steps):
         state, step_metrics = step_fn(state, next(batch_iter))
