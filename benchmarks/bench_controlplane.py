@@ -399,6 +399,370 @@ def run_tenant_bench(tenants: int, jobs_per_tenant: int, workers: int,
     }
 
 
+class WorkUnitKubelet(threading.Thread):
+    """Fake data plane for the elastic/oversubscribe scenario: models a
+    DATA-PARALLEL training job whose throughput is proportional to the
+    slices it currently holds. Per tick, a gang whose expected worker
+    pods are ALL Running advances its job-level progress by its current
+    ``spec.slice.numSlices`` work units; pods publish CheckpointRecords
+    on the periodic cadence and ack save-before-evict barriers at the
+    CURRENT progress (so an acked shrink loses zero committed steps).
+    Restore semantics are faithful: a fresh incarnation resumes from
+    its rendered ``TPUJOB_RESTORE_STEP`` — uncommitted progress past
+    the last save is genuinely lost on a world restart, which is
+    exactly the cost the goodput comparison must charge resizes for."""
+
+    def __init__(self, store: Store, work_units: int, admitted=None,
+                 tick: float = 0.01, save_interval: int = 20):
+        super().__init__(name="workunit-kubelet", daemon=True)
+        self.store = store
+        self.work_units = work_units
+        self.admitted = admitted
+        self.tick = tick
+        self.save_interval = save_interval
+        self.progress: Dict[str, int] = {}       # job name -> work units
+        self.min_slices_violations: List[str] = []
+        self._acked: Dict[Tuple[str, str, str], str] = {}
+        self._last_save: Dict[str, int] = {}
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        from tf_operator_tpu.api.types import (
+            CheckpointRecord,
+            CheckpointRecordStatus,
+        )
+
+        while not self._stop.is_set():
+            jobs = {j.metadata.name: j for j in self.store.list(
+                store_mod.TPUJOBS, namespace=NAMESPACE)}
+            pods_by_job: Dict[str, list] = {}
+            for p in self.store.list(store_mod.PODS, namespace=NAMESPACE):
+                if p.status.phase in ("Succeeded", "Failed"):
+                    continue
+                jn = p.metadata.labels.get(constants.LABEL_JOB_NAME, "")
+                pods_by_job.setdefault(jn, []).append(p)
+            for name, job in jobs.items():
+                sl = job.spec.slice
+                if (sl.min_slices is not None
+                        and sl.num_slices < sl.min_slices):
+                    self.min_slices_violations.append(
+                        f"job {name}: numSlices {sl.num_slices} < "
+                        f"minSlices {sl.min_slices}")
+                self._drive(job, pods_by_job.get(name, []),
+                            CheckpointRecord, CheckpointRecordStatus)
+            self._stop.wait(self.tick)
+
+    def _drive(self, job, pods, record_cls, status_cls) -> None:
+        name = job.metadata.name
+        expected = sum(s.replicas or 0
+                       for s in job.spec.replica_specs.values())
+        for p in pods:
+            if p.status.phase == PodPhase.PENDING:
+                if (self.admitted is not None
+                        and not self.admitted(p.metadata.namespace, name)):
+                    continue
+                self._start(p, name)
+        running = [p for p in pods if p.status.phase == PodPhase.RUNNING]
+        if name not in self.progress:
+            return
+        progress = self.progress[name]
+        # Barrier acks first, at the CURRENT progress — and no progress
+        # is advanced while a notice is outstanding, so the committed
+        # step equals the progress the shrink evicts at (zero lost).
+        noticed = False
+        for p in running:
+            notice = p.metadata.annotations.get(
+                constants.ANNOTATION_PREEMPT_NOTICE, "")
+            if not notice:
+                continue
+            noticed = True
+            key = (p.metadata.namespace, p.metadata.name, p.metadata.uid)
+            if self._acked.get(key) != notice:
+                barrier = json.loads(notice).get("barrier", "")
+                self._publish(p, progress, barrier, record_cls,
+                              status_cls)
+                self._acked[key] = notice
+        if noticed:
+            return
+        if expected == 0 or len(running) != expected or len(pods) != expected:
+            return  # gang not fully up (admission gate or mid-restart)
+        progress += job.spec.slice.num_slices
+        self.progress[name] = progress
+        if (progress - self._last_save.get(name, 0) >= self.save_interval
+                or progress >= self.work_units):
+            self._last_save[name] = progress
+            for p in running:
+                self._publish(p, progress, "", record_cls, status_cls)
+        if progress >= self.work_units:
+            for p in pods:
+                patch = Pod(metadata=ObjectMeta(
+                    name=p.metadata.name,
+                    namespace=p.metadata.namespace))
+                patch.status = PodStatus(
+                    phase=PodPhase.SUCCEEDED, start_time=testutil.now(),
+                    container_statuses=[ContainerStatus(
+                        name=constants.DEFAULT_CONTAINER_NAME,
+                        state="Terminated", exit_code=0)])
+                try:
+                    self.store.update_status(store_mod.PODS, patch)
+                except (store_mod.NotFoundError, store_mod.ConflictError):
+                    pass
+
+    def _start(self, pod, job_name: str) -> None:
+        restore = None
+        for c in pod.spec.containers:
+            if constants.ENV_RESTORE_STEP in c.env:
+                restore = int(c.env[constants.ENV_RESTORE_STEP])
+        if restore is not None:
+            # World restart: the incarnation resumes from the committed
+            # step — uncommitted progress past the last save is lost
+            # (the honest cost of a resize restart).
+            self.progress[job_name] = restore
+            self._last_save[job_name] = restore
+        else:
+            self.progress.setdefault(job_name, 0)
+        patch = Pod(metadata=ObjectMeta(name=pod.metadata.name,
+                                        namespace=pod.metadata.namespace))
+        patch.status = PodStatus(phase=PodPhase.RUNNING,
+                                 start_time=testutil.now())
+        try:
+            self.store.update_status(store_mod.PODS, patch)
+        except (store_mod.NotFoundError, store_mod.ConflictError):
+            pass
+
+    def _publish(self, pod, step: int, barrier: str, record_cls,
+                 status_cls) -> None:
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        status = status_cls(step=step, progress_step=step,
+                            barrier_id=barrier, directory="/bench/ckpt",
+                            save_seconds=0.001, updated_at=testutil.now())
+        try:
+            existing = self.store.try_get(store_mod.CHECKPOINTRECORDS,
+                                          ns, name)
+            if existing is None:
+                self.store.create(store_mod.CHECKPOINTRECORDS, record_cls(
+                    metadata=ObjectMeta(
+                        name=name, namespace=ns,
+                        labels=dict(pod.metadata.labels),
+                        owner_references=[r.deepcopy() for r in
+                                          pod.metadata.owner_references]),
+                    status=status))
+            else:
+                existing.status = status
+                self.store.update_status(store_mod.CHECKPOINTRECORDS,
+                                         existing)
+        except (store_mod.AlreadyExistsError, store_mod.ConflictError,
+                store_mod.NotFoundError):
+            pass
+
+
+def _resize_counts() -> Dict[str, float]:
+    """Current gang_resizes totals by direction (labels: direction,
+    reason)."""
+    from tf_operator_tpu.runtime import metrics
+
+    out = {"grow": 0.0, "shrink": 0.0}
+    for labels, v in metrics.gang_resizes.collect():
+        out[labels[0]] = out.get(labels[0], 0.0) + v
+    return out
+
+
+def _oversubscribe_once(elastic: bool, tenants: int, threadiness: int,
+                        timeout: float, chips_per_slice: int,
+                        work_units: int, stagger: float,
+                        save_interval: int, barrier_timeout: float,
+                        kubelet_tick: float) -> Dict:
+    """One oversubscribe run: ``tenants`` queues over one cohort, each
+    submitting ONE elastic job (minSlices=1, maxSlices=tenants) at
+    ``stagger``-second intervals against a cluster that fits exactly
+    one slice per tenant. With ``elastic`` on, the resize pass grows
+    early arrivals into the idle capacity and shrinks them (zero
+    committed steps lost, via the save-before-evict barrier) as later
+    tenants' nominal demands arrive; off, every job is pinned at its
+    nominal single slice — the static-allocation baseline."""
+    from tf_operator_tpu.api.types import (
+        CheckpointPolicy,
+        ClusterQueue,
+        ClusterQueueSpec,
+        TenantQueue,
+        TenantQueueSpec,
+    )
+    from tf_operator_tpu.controller.ckpt import CheckpointCoordinator
+    from tf_operator_tpu.controller.engine import EngineConfig
+    from tf_operator_tpu.controller.gang import (
+        PHASE_INQUEUE,
+        PHASE_RUNNING,
+        SliceGangScheduler,
+    )
+    from tf_operator_tpu.controller.quota import TenantQueueManager
+    from tf_operator_tpu.runtime import metrics
+
+    store = Store()
+    total_chips = tenants * chips_per_slice
+    quota = TenantQueueManager(store)
+    ckpt = CheckpointCoordinator(store).start()
+    gang = SliceGangScheduler(store, total_chips=total_chips,
+                              quota=quota, ckpt=ckpt, elastic=elastic)
+    ckpt.on_ack = gang.readmit
+    controller = TPUJobController(
+        store, config=EngineConfig(enable_gang_scheduling=True),
+        gang=gang, namespace=NAMESPACE, ckpt=ckpt)
+    for t in range(tenants):
+        cq = ClusterQueue(spec=ClusterQueueSpec(
+            nominal_chips=chips_per_slice, cohort="bench"))
+        cq.metadata.name = f"cq-tenant-{t}"
+        cq.metadata.namespace = ""
+        store.create(store_mod.CLUSTERQUEUES, cq)
+        tq = TenantQueue(spec=TenantQueueSpec(
+            cluster_queue=f"cq-tenant-{t}"))
+        tq.metadata.name = f"tenant-{t}"
+        tq.metadata.namespace = NAMESPACE
+        store.create(store_mod.TENANTQUEUES, tq)
+
+    def group_admitted(ns: str, job_name: str) -> bool:
+        g = store.try_get(store_mod.SLICEGROUPS, ns, job_name)
+        return g is not None and g.status.phase in (PHASE_INQUEUE,
+                                                    PHASE_RUNNING)
+
+    kubelet = WorkUnitKubelet(store, work_units=work_units,
+                              admitted=group_admitted, tick=kubelet_tick,
+                              save_interval=save_interval)
+    resizes_before = _resize_counts()
+    acked_before = metrics.checkpoint_barriers.value(
+        job_namespace=NAMESPACE, outcome="acked")
+    timeout_before = metrics.checkpoint_barriers.value(
+        job_namespace=NAMESPACE, outcome="timeout")
+    lost_before = metrics.steps_lost_per_disruption.sum_value(
+        job_namespace=NAMESPACE)
+
+    stop_resync = threading.Event()
+
+    def resync() -> None:
+        # Steady-state grows have no store event to ride (nothing
+        # changes until the resize pass itself acts): the production
+        # resync loop is what re-drives admission, so the bench runs
+        # one too.
+        while not stop_resync.wait(0.05):
+            try:
+                for key in store.project(store_mod.TPUJOBS,
+                                         lambda j: j.key(),
+                                         namespace=NAMESPACE):
+                    controller.enqueue(key)
+            except Exception:
+                pass
+
+    resync_thread = threading.Thread(target=resync, name="resync",
+                                     daemon=True)
+    controller.run(threadiness=threadiness)
+    kubelet.start()
+    resync_thread.start()
+    t0 = time.perf_counter()
+    try:
+        for t in range(tenants):
+            if t > 0:
+                time.sleep(stagger)
+            job = testutil.new_tpujob(worker=1, name=f"bench-os-{t}",
+                                      namespace=NAMESPACE)
+            job.spec.slice.accelerator = f"v5e-{chips_per_slice}"
+            job.spec.slice.num_slices = 1
+            if elastic:
+                job.spec.slice.min_slices = 1
+                job.spec.slice.max_slices = tenants
+            job.spec.queue_name = f"tenant-{t}"
+            job.spec.run_policy.checkpoint_policy = CheckpointPolicy(
+                enabled=True, directory="/bench/ckpt",
+                interval_steps=save_interval,
+                barrier_timeout_seconds=barrier_timeout)
+            store.create(store_mod.TPUJOBS, job)
+
+        deadline = t0 + timeout
+        while True:
+            succeeded = sum(store.project(
+                store_mod.TPUJOBS,
+                lambda j: 1 if cond.is_succeeded(j.status) else None,
+                namespace=NAMESPACE))
+            if succeeded >= tenants:
+                break
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"{succeeded}/{tenants} jobs Succeeded after "
+                    f"{timeout}s (elastic={elastic})")
+            time.sleep(0.02)
+        makespan = time.perf_counter() - t0
+    finally:
+        stop_resync.set()
+        kubelet.stop()
+        controller.stop()
+        ckpt.stop()
+        store.stop_watchers()
+
+    resizes_after = _resize_counts()
+    total_work = tenants * work_units
+    return {
+        "elastic": elastic,
+        "makespan_seconds": round(makespan, 3),
+        "goodput_units_per_sec": round(total_work / makespan, 2),
+        "resizes_grow": int(resizes_after["grow"]
+                            - resizes_before["grow"]),
+        "resizes_shrink": int(resizes_after["shrink"]
+                              - resizes_before["shrink"]),
+        "barriers_acked": int(metrics.checkpoint_barriers.value(
+            job_namespace=NAMESPACE, outcome="acked") - acked_before),
+        "barriers_timeout": int(metrics.checkpoint_barriers.value(
+            job_namespace=NAMESPACE, outcome="timeout")
+            - timeout_before),
+        "steps_lost_total": int(
+            metrics.steps_lost_per_disruption.sum_value(
+                job_namespace=NAMESPACE) - lost_before),
+        "min_slices_violations": list(kubelet.min_slices_violations[:8]),
+    }
+
+
+def run_oversubscribe_bench(tenants: int, threadiness: int,
+                            timeout: float, chips_per_slice: int = 4,
+                            work_units: int = 480, stagger: float = 1.0,
+                            save_interval: int = 10,
+                            barrier_timeout: float = 10.0,
+                            kubelet_tick: float = 0.01) -> Dict:
+    """Oversubscribe scenario (ROADMAP item 2 acceptance): N tenants
+    over-subscribe a cluster that holds exactly one nominal slice per
+    tenant; the SAME staggered submission schedule is run twice — with
+    the elastic resize pass on, and pinned at static nominal
+    allocation — and aggregate goodput (work units completed per wall
+    second) is compared. Elastic must win by riding idle capacity early
+    and degrading (shrink, keep training) instead of idling when
+    reclaim pressure arrives."""
+    static = _oversubscribe_once(
+        False, tenants, threadiness, timeout, chips_per_slice,
+        work_units, stagger, save_interval, barrier_timeout,
+        kubelet_tick)
+    elastic = _oversubscribe_once(
+        True, tenants, threadiness, timeout, chips_per_slice,
+        work_units, stagger, save_interval, barrier_timeout,
+        kubelet_tick)
+    gain = (elastic["goodput_units_per_sec"]
+            / max(1e-9, static["goodput_units_per_sec"]) - 1.0) * 100.0
+    return {
+        "tenants": tenants,
+        "jobs": tenants,
+        "chips_per_slice": chips_per_slice,
+        "cluster_chips": tenants * chips_per_slice,
+        "max_slices": tenants,
+        "work_units_per_job": work_units,
+        "stagger_seconds": stagger,
+        "save_interval_steps": save_interval,
+        "threadiness": threadiness,
+        "goodput_gain_pct": round(gain, 2),
+        "elastic": elastic,
+        "static": static,
+        "invariant_violations": list(elastic["min_slices_violations"])
+        + list(static["min_slices_violations"]),
+    }
+
+
 class CkptFakeKubelet(FakeKubelet):
     """FakeKubelet that also plays the checkpointing WORKER + node agent
     (the data-plane relay the local backend provides in production):
@@ -704,7 +1068,8 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
                     kubelet_tick: float = 0.01,
                     crash_restarts: int = 1,
                     resync_period: float = 0.5,
-                    profile=None) -> Dict:
+                    profile=None,
+                    elastic: bool = False) -> Dict:
     """Chaos scenario: the FULL control plane (gang admission +
     checkpoint barriers + disruptions) reconciling through a seeded
     ``FaultProfile`` (runtime/chaos.py) injected between the operator
@@ -717,7 +1082,15 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
     entries, and the post-convergence INVARIANT CHECKS (orphans,
     duplicate admissions / capacity breaches, unresolved barriers,
     committed-step regressions) — ``invariant_violations`` must be
-    empty for the run to count."""
+    empty for the run to count.
+
+    ``elastic=True`` additionally turns the resize pass on: jobs
+    declare minSlices=1/maxSlices=2, a spare slice of budget lets the
+    grow pass fire, and a resize exerciser requests barrier-gated
+    shrinks through the faults — with three extra invariants sampled
+    mid-resize: never below minSlices, admitted chips never above the
+    budget at the per-group CURRENT size, and every shrink barrier
+    resolving acked|timeout."""
     from tf_operator_tpu.api.types import CheckpointPolicy
     from tf_operator_tpu.controller.ckpt import CheckpointCoordinator
     from tf_operator_tpu.controller.engine import EngineConfig
@@ -742,9 +1115,15 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
     chaos = ChaosStore(base, profile)
     # Capacity below aggregate demand forces real queueing, so the
     # duplicate-admission/capacity invariant is load-bearing, not
-    # vacuous. Chips free as jobs finish (slicegroup deleted).
-    total_chips = max(chips_per_job,
-                      int(jobs * chips_per_job * capacity_fraction))
+    # vacuous. Chips free as jobs finish (slicegroup deleted). Elastic
+    # runs instead get ONE spare slice of headroom: every gang admits
+    # and the grow pass has exactly one slice to fight over, so
+    # resizes churn while the budget invariant still bites.
+    if elastic:
+        total_chips = (jobs + 1) * chips_per_job
+    else:
+        total_chips = max(chips_per_job,
+                          int(jobs * chips_per_job * capacity_fraction))
 
     holder: Dict[str, object] = {}
     dur_acc: List[float] = []  # sync durations across crash-restarts
@@ -757,7 +1136,8 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
         cp_health = ControlPlaneHealth(threshold_seconds=1.0)
         ckpt = CheckpointCoordinator(chaos).start()
         gang = SliceGangScheduler(chaos, total_chips=total_chips,
-                                  ckpt=ckpt, cp_health=cp_health)
+                                  ckpt=ckpt, cp_health=cp_health,
+                                  elastic=elastic)
         ckpt.on_ack = gang.readmit
         controller = TPUJobController(
             chaos, config=EngineConfig(enable_gang_scheduling=True),
@@ -841,6 +1221,38 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
     injected = [0]
     stop_aux = threading.Event()
     max_admitted = [0]
+    shrinks_landed = [0]
+    # Bounded shrink exerciser: unbounded shrink/grow churn could eat
+    # a pod's uncommitted progress faster than it accrues (a grow
+    # restart legitimately rolls back to the committed step), stalling
+    # convergence — real clusters pace resizes off real pressure.
+    resize_budget = [max(2, disruptions)] if elastic else [0]
+
+    def exercise_resizes() -> None:
+        """Request barrier-gated shrinks of grown gangs through the
+        fault-injecting store; the grow pass refills them. Stops after
+        the budget so convergence stays reachable."""
+        while not stop_aux.is_set() and resize_budget[0] > 0:
+            gang = holder["gang"]
+            try:
+                target = None
+                for j in base.list(store_mod.TPUJOBS, namespace=NAMESPACE):
+                    sl = j.spec.slice
+                    if (sl.min_slices is not None
+                            and sl.num_slices > sl.min_slices
+                            and not cond.is_finished(j.status)):
+                        target = j.metadata.name
+                        break
+                if target is None:
+                    stop_aux.wait(kubelet_tick)
+                    continue
+                if gang.try_shrink(NAMESPACE, target, 1, "chaos",
+                                   "chaos shrink"):
+                    shrinks_landed[0] += 1
+                    resize_budget[0] -= 1
+            except Exception:
+                pass  # injected fault; retry next tick
+            stop_aux.wait(kubelet_tick)
 
     def disrupt() -> None:
         """Round-robin planned disruptions through the (current)
@@ -908,15 +1320,33 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
 
     def sample_admission() -> None:
         """Duplicate-admission probe: the chips admitted concurrently
-        must never exceed the budget."""
+        must never exceed the budget — at each group's CURRENT size,
+        so the invariant stays load-bearing mid-resize. Also samples
+        the never-below-minSlices floor on every job spec."""
+        from tf_operator_tpu.controller.gang import _chips_for
+
+        floor_broken: set = set()
         while not stop_aux.wait(0.05):
             used = sum(base.project(
                 store_mod.SLICEGROUPS,
-                lambda g: (chips_per_job
+                lambda g: (_chips_for(g)
                            if g.status.phase in (PHASE_INQUEUE,
                                                  PHASE_RUNNING)
                            else None)))
             max_admitted[0] = max(max_admitted[0], used)
+            if not elastic:
+                continue
+            for name, cur, mn in base.project(
+                    store_mod.TPUJOBS,
+                    lambda j: (j.metadata.name, j.spec.slice.num_slices,
+                               j.spec.slice.min_slices),
+                    namespace=NAMESPACE):
+                if (mn is not None and cur < mn
+                        and name not in floor_broken):
+                    floor_broken.add(name)
+                    violations.append(
+                        f"job {name} resized to {cur} slice(s), below "
+                        f"minSlices {mn}")
 
     acked_before = metrics.checkpoint_barriers.value(
         job_namespace=NAMESPACE, outcome="acked")
@@ -928,18 +1358,27 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
 
     build()
     kubelet.start()
+    aux_specs = [(disrupt, "disruptor"), (resync, "resync"),
+                 (sample_admission, "admission-probe")]
+    if elastic:
+        aux_specs.append((exercise_resizes, "resize-exerciser"))
     aux = [threading.Thread(target=fn, daemon=True, name=name)
-           for fn, name in ((disrupt, "disruptor"),
-                            (resync, "resync"),
-                            (sample_admission, "admission-probe"))]
+           for fn, name in aux_specs]
     t0 = time.perf_counter()
     crashes_done = 0
     try:
         for i in range(jobs):
-            job = testutil.new_tpujob(worker=workers,
+            # Elastic jobs couple the worker count to the slice count
+            # (one host per v5e-4 slice), so the resize pass scales
+            # both; the non-elastic shape keeps the historical
+            # `workers` fan-out.
+            job = testutil.new_tpujob(worker=1 if elastic else workers,
                                       name=f"bench-{i:04d}",
                                       namespace=NAMESPACE)
             job.spec.slice.accelerator = f"v5e-{chips_per_job}"
+            if elastic:
+                job.spec.slice.min_slices = 1
+                job.spec.slice.max_slices = 2
             job.spec.run_policy.checkpoint_policy = CheckpointPolicy(
                 enabled=True, directory="/bench/ckpt",
                 interval_steps=save_interval,
@@ -1013,9 +1452,10 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
         job_namespace=NAMESPACE, outcome="acked") - acked_before)
     barriers_timeout = int(metrics.checkpoint_barriers.value(
         job_namespace=NAMESPACE, outcome="timeout") - timeout_before)
-    if barriers_acked + barriers_timeout < injected[0]:
+    if barriers_acked + barriers_timeout < injected[0] + shrinks_landed[0]:
         violations.append(
-            f"{injected[0]} disruptions displaced but only "
+            f"{injected[0]} disruptions displaced + {shrinks_landed[0]} "
+            f"shrinks landed but only "
             f"{barriers_acked + barriers_timeout} barriers resolved "
             "(a barrier was left unresolved)")
     finished = {(j.metadata.namespace, j.metadata.name)
@@ -1057,6 +1497,8 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
         "barriers_timeout": barriers_timeout,
         "total_chips": total_chips,
         "max_admitted_chips": max_admitted[0],
+        "elastic": elastic,
+        "shrinks_landed": shrinks_landed[0],
         "invariant_violations": violations,
     }
 
@@ -1133,16 +1575,47 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="(--chaos) FaultProfile seed")
     p.add_argument("--crash-restarts", type=int, default=1,
                    help="(--chaos) operator crash-restarts to inject")
+    p.add_argument("--elastic", action="store_true",
+                   help="(--chaos) enable the elastic resize pass: "
+                        "jobs declare minSlices/maxSlices, the grow "
+                        "pass and a shrink exerciser churn resizes "
+                        "through the faults, and the elastic "
+                        "invariants (never below minSlices, budget "
+                        "held mid-resize, every shrink barrier "
+                        "resolved) are checked")
+    p.add_argument("--oversubscribe", type=int, default=0,
+                   help="N>0 switches to the elastic oversubscribe "
+                        "scenario (docs/elastic.md): N tenants over a "
+                        "cluster holding one nominal slice each, same "
+                        "staggered schedule run elastic vs static; "
+                        "the artifact reports the aggregate-goodput "
+                        "gain (acceptance: >=20% at the default "
+                        "3-tenant shape)")
+    p.add_argument("--work-units", type=int, default=480,
+                   help="(--oversubscribe) work units per job (one "
+                        "unit per slice per kubelet tick)")
+    p.add_argument("--stagger", type=float, default=1.0,
+                   help="(--oversubscribe) seconds between tenant "
+                        "submissions")
     args = p.parse_args(argv)
 
     config = {"jobs": args.jobs, "workers": args.workers,
               "threadiness": args.threadiness,
               "kubelet_tick": args.kubelet_tick}
-    if args.chaos is not None:
+    if args.oversubscribe > 0:
+        config.update({"oversubscribe": args.oversubscribe,
+                       "work_units": args.work_units,
+                       "stagger": args.stagger,
+                       "chips_per_slice": args.chips_per_job})
+        metric = (f"controlplane_oversubscribe_goodput_gain"
+                  f"[{args.oversubscribe}t w{args.work_units}]")
+    elif args.chaos is not None:
         config.update({"chaos": args.chaos, "seed": args.chaos_seed,
-                       "crash_restarts": args.crash_restarts})
+                       "crash_restarts": args.crash_restarts,
+                       "elastic": args.elastic})
         metric = (f"controlplane_chaos_convergence_jobs_per_sec"
-                  f"[{args.jobs}x{args.workers} {args.chaos}]")
+                  f"[{args.jobs}x{args.workers} {args.chaos}"
+                  f"{' elastic' if args.elastic else ''}]")
     elif args.tenants > 0:
         config.update({"tenants": args.tenants,
                        "chips_per_job": args.chips_per_job})
@@ -1158,13 +1631,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         metric = (f"controlplane_convergence_jobs_per_sec"
                   f"[{args.jobs}x{args.workers}]")
     try:
-        if args.chaos is not None:
+        if args.oversubscribe > 0:
+            result = run_oversubscribe_bench(
+                args.oversubscribe, args.threadiness, args.timeout,
+                chips_per_slice=args.chips_per_job,
+                work_units=args.work_units, stagger=args.stagger,
+                kubelet_tick=args.kubelet_tick)
+        elif args.chaos is not None:
             result = run_chaos_bench(
                 args.jobs, args.workers, args.threadiness, args.timeout,
                 profile_name=args.chaos, seed=args.chaos_seed,
                 disruptions=max(args.disruptions, 2),
                 crash_restarts=args.crash_restarts,
-                kubelet_tick=args.kubelet_tick)
+                kubelet_tick=args.kubelet_tick,
+                elastic=args.elastic)
         elif args.tenants > 0:
             result = run_tenant_bench(
                 args.tenants, args.jobs, args.workers, args.threadiness,
@@ -1180,7 +1660,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             result = run_bench(args.jobs, args.workers, args.threadiness,
                                args.timeout,
                                kubelet_tick=args.kubelet_tick)
-        if args.disruptions > 0:
+        if args.oversubscribe > 0:
+            value, unit = result["goodput_gain_pct"], "percent"
+        elif args.disruptions > 0:
             value, unit = result.get("goodput_ratio_mean"), "ratio"
         else:
             value, unit = result["jobs_per_sec"], "jobs/sec"

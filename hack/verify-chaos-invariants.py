@@ -25,6 +25,10 @@ asserts the post-convergence invariants:
 5. **Restart-with-identity never loses committed steps**: no recreated
    worker restores from below the committed-step watermark recorded at
    its eviction.
+6. **Elastic invariants** (rounds with the resize pass on): a gang is
+   never resized below its ``minSlices`` floor, admitted chips stay
+   within the budget at each group's CURRENT size mid-resize, and
+   every shrink's save-before-evict barrier resolves acked|timeout.
 
 The harness is ``benchmarks/bench_controlplane.py run_chaos_bench`` —
 the same machinery the ``--chaos`` scenario pins at the 200x16 shape —
@@ -74,28 +78,43 @@ def random_profile(rng: random.Random, seed: int) -> FaultProfile:
 
 
 def run_round(seed: int, timeout: float = 120.0,
-              verbose: bool = False) -> List[str]:
+              verbose: bool = False,
+              elastic: Optional[bool] = None) -> List[str]:
     """One randomized round; returns invariant violations ([] = clean).
     A convergence timeout IS a violation — under any profile the fleet
-    must converge, that is the level-triggered contract."""
+    must converge, that is the level-triggered contract.
+
+    ``elastic`` turns the resize pass on for the round (minSlices/
+    maxSlices gangs, the grow pass plus a barrier-gated shrink
+    exerciser, and the three elastic invariants: never below
+    minSlices, budget held at each group's current size mid-resize,
+    every shrink barrier resolved). None = drawn from the seed —
+    drawn LAST so the fleet shape and fault profile of historical
+    seeds stay byte-identical."""
     rng = random.Random(seed)
     jobs = rng.randint(3, 6)
     workers = rng.randint(2, 3)
     disruptions = rng.randint(1, 2)
     profile = random_profile(rng, seed)
+    threadiness = rng.choice((2, 4))
+    if elastic is None:
+        elastic = rng.random() < 0.5
     try:
         result = bench_controlplane.run_chaos_bench(
-            jobs=jobs, workers=workers, threadiness=rng.choice((2, 4)),
+            jobs=jobs, workers=workers, threadiness=threadiness,
             timeout=timeout, seed=seed, profile=profile,
             disruptions=disruptions, steps=30, save_interval=8,
             barrier_timeout=8.0, crash_restarts=1,
-            resync_period=0.25)
+            resync_period=0.25, elastic=elastic)
     except TimeoutError as e:
-        return [f"no convergence under profile seed {seed}: {e}"]
+        return [f"no convergence under profile seed {seed} "
+                f"(elastic={elastic}): {e}"]
     if verbose:
         print(f"  seed {seed}: {jobs}x{workers} d{disruptions} "
+              f"elastic={elastic} "
               f"faults={result['faults_injected_total']} "
               f"retries={result['retries_total']} "
+              f"shrinks={result['shrinks_landed']} "
               f"converged {result['convergence_seconds']}s",
               file=sys.stderr)
     return list(result["invariant_violations"])
@@ -126,7 +145,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 1
     print("OK: converged under every fault profile; no orphans, no "
           "duplicate admissions, every barrier resolved, no committed "
-          "steps lost", file=sys.stderr)
+          "steps lost, elastic floors/budget held", file=sys.stderr)
     return 0
 
 

@@ -167,6 +167,52 @@ def test_chaos_scenario_smoke_and_artifact_schema(capsys):
     assert ENV_KEYS <= set(artifact["env"])
 
 
+def test_oversubscribe_scenario_smoke_and_artifact_schema(capsys):
+    """--oversubscribe N: the SAME staggered tenant schedule run twice
+    (elastic resize pass on vs static nominal allocation); the
+    artifact carries both runs plus the aggregate-goodput gain. The
+    tiny-shape smoke pins the mechanics, not the full acceptance
+    number (that is the default shape's job): resizes actually
+    happened, every shrink rode an acked barrier with ZERO committed
+    steps lost, the minSlices floor held, and elastic did not lose to
+    static."""
+    rc = bench_controlplane.main(["--oversubscribe", "3",
+                                  "--work-units", "120",
+                                  "--stagger", "0.4",
+                                  "--timeout", "90"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "artifact must be exactly one line"
+    artifact = json.loads(out[0])
+    assert artifact["metric"].startswith(
+        "controlplane_oversubscribe_goodput_gain")
+    assert artifact["unit"] == "percent"
+    assert artifact["value"] == artifact["goodput_gain_pct"]
+    assert artifact["tenants"] == 3
+    assert artifact["cluster_chips"] == 3 * artifact["chips_per_slice"]
+    for mode in ("elastic", "static"):
+        stats = artifact[mode]
+        assert {"makespan_seconds", "goodput_units_per_sec",
+                "resizes_grow", "resizes_shrink", "barriers_acked",
+                "barriers_timeout", "steps_lost_total",
+                "min_slices_violations"} <= set(stats)
+        assert stats["min_slices_violations"] == []
+    assert artifact["static"]["resizes_grow"] == 0
+    assert artifact["static"]["resizes_shrink"] == 0
+    # The elastic run actually rode the machinery: at least one grow
+    # into idle capacity and one barrier-gated shrink under reclaim...
+    assert artifact["elastic"]["resizes_grow"] >= 1
+    assert artifact["elastic"]["resizes_shrink"] >= 1
+    assert artifact["elastic"]["barriers_acked"] >= 1
+    # ...with zero committed steps lost across all shrinks, and the
+    # elastic fleet at least matching static goodput even at a shape
+    # too small to amortize the resize restarts fully.
+    assert artifact["elastic"]["steps_lost_total"] == 0
+    assert artifact["goodput_gain_pct"] > 0
+    assert artifact["invariant_violations"] == []
+    assert ENV_KEYS <= set(artifact["env"])
+
+
 def test_failure_still_emits_one_json_line(capsys):
     # Impossible timeout: the artifact contract holds on failure too.
     rc = bench_controlplane.main(["--jobs", "2", "--workers", "1",

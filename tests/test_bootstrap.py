@@ -188,6 +188,36 @@ def test_out_of_range_index_gets_unique_rank():
     assert int(env["JAX_NUM_PROCESSES"]) >= 4
 
 
+def test_out_of_range_render_references_no_nonexistent_pods():
+    """Elastic-grow transient (bootstrap/cluster.py): a worker rendered
+    with an index beyond spec.replicas must see a cluster view made of
+    pods that EXIST — the declared replicas plus itself — and never a
+    hostname for an index between replicas and its own (those pods
+    have not been created yet, so a worker handed them would dial
+    hosts that do not resolve)."""
+    job = make_job(worker=2, accelerator="v5e-16")  # 2 hosts/slice
+    env = render_worker_env(job, "worker", 5, domain="")
+    # Slice window for index 5 is workers 4..5; workers 2..4 do not
+    # exist — only the pod's own name may appear.
+    assert env["TPU_WORKER_HOSTNAMES"] == \
+        "test-cluster-spec-worker-5.default.svc"
+    cluster = json.loads(env["TPUJOB_CLUSTER_SPEC"])
+    workers = cluster["cluster"]["worker"]
+    # The view holds the declared replicas plus the rendered pod
+    # itself, and nothing in between.
+    assert workers == [
+        "test-cluster-spec-worker-0.default.svc:8470",
+        "test-cluster-spec-worker-1.default.svc:8470",
+        "test-cluster-spec-worker-5.default.svc:8470",
+    ]
+    for missing in (2, 3, 4):
+        assert f"worker-{missing}" not in env["TPUJOB_CLUSTER_SPEC"]
+        assert f"worker-{missing}" not in env["TPU_WORKER_HOSTNAMES"]
+    # Rank identity stays unique and in range (the pre-existing pin).
+    assert env["JAX_PROCESS_ID"] == "5"
+    assert int(env["JAX_NUM_PROCESSES"]) >= 6
+
+
 def test_single_process_job_gets_no_cluster_env():
     # Reference isDistributed (pod.go:296-317): single-process jobs get no
     # TF_CONFIG; here no JAX_*/cluster-spec env.

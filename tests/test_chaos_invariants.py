@@ -31,7 +31,20 @@ SCRIPT = os.path.join(REPO, "hack", "verify-chaos-invariants.py")
 # the harness to model the production restore fallback faithfully.
 # Seed 1023 exposed the harness hanging on its remaining disruption
 # count after every job had already converged (no live gang left).
+# Historical seeds are pinned with elastic=False so their schedules
+# stay byte-identical to the round that found them.
 PINNED_SEEDS = (100, 103, 1000, 1004, 1015, 1020, 1023)
+
+# Elastic-resize seeds (run with the resize pass ON: minSlices floor,
+# budget-held-mid-resize, every shrink barrier resolved). Seed 100
+# with elastic exposed in-flight-grow double-booking ACROSS an
+# operator crash-restart during development — the in-memory grow
+# ledger died with the process and the rebuilt scheduler spent the
+# same free chips again before the grown group's spec synced; the
+# charge is now also derived from the persisted job-vs-group slice
+# delta, which survives the crash. 2000/2002/2003 are clean-coverage
+# sweeps of the grow/shrink churn.
+ELASTIC_PINNED_SEEDS = (100, 2000, 2002, 2003)
 
 
 def _load():
@@ -44,8 +57,15 @@ def _load():
 def test_pinned_seeds_hold_invariants():
     vc = _load()
     for seed in PINNED_SEEDS:
-        errors = vc.run_round(seed, timeout=120.0)
+        errors = vc.run_round(seed, timeout=120.0, elastic=False)
         assert not errors, f"seed {seed}: {errors}"
+
+
+def test_elastic_pinned_seeds_hold_invariants():
+    vc = _load()
+    for seed in ELASTIC_PINNED_SEEDS:
+        errors = vc.run_round(seed, timeout=120.0, elastic=True)
+        assert not errors, f"seed {seed} (elastic): {errors}"
 
 
 def test_cli_entrypoint_runs_clean():

@@ -226,7 +226,7 @@ class TestBarrier:
                                              recorder):
         before = metrics.checkpoint_barriers.value(
             job_namespace=NS, outcome=OUTCOME_ACKED)
-        add_job(store, "j", policy=ckpt_policy())
+        add_job(store, "j", policy=ckpt_policy(), workers=2)
         add_pod(store, "j", 0)
         add_pod(store, "j", 1)
         assert coord.ready_to_evict(NS, "j", "drain") is False
@@ -247,7 +247,8 @@ class TestBarrier:
     def test_timeout_releases_eviction(self, store, coord, recorder,
                                        clock):
         add_job(store, "j",
-                policy=ckpt_policy(barrier_timeout_seconds=30))
+                policy=ckpt_policy(barrier_timeout_seconds=30),
+                workers=2)
         add_pod(store, "j", 0)
         add_pod(store, "j", 1)
         assert coord.ready_to_evict(NS, "j", "drain") is False
@@ -260,7 +261,8 @@ class TestBarrier:
     def test_partial_ack_then_timeout_counts_lost_steps(
             self, store, coord, clock):
         add_job(store, "j",
-                policy=ckpt_policy(barrier_timeout_seconds=30))
+                policy=ckpt_policy(barrier_timeout_seconds=30),
+                workers=2)
         add_pod(store, "j", 0)
         add_pod(store, "j", 1)
         # Periodic saves exist: worker-0 saved step 10, worker-1 step 10
@@ -344,7 +346,10 @@ class TestRestore:
         assert coord.bootstrap_env(job) == {}
 
     def test_restore_step_is_min_committed(self, store, coord):
-        job = add_job(store, "j", policy=ckpt_policy())
+        # Two declared workers: records beyond the job's CURRENT
+        # replica set are ignored (elastic shrink hygiene, ckpt.py
+        # _record_in_world), so the world must match the records.
+        job = add_job(store, "j", policy=ckpt_policy(), workers=2)
         add_record(store, "j", "j-worker-0", step=30)
         add_record(store, "j", "j-worker-1", step=20)
         env = coord.bootstrap_env(job)

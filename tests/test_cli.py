@@ -109,6 +109,50 @@ def test_main_rejects_malformed_gang_map(capsys):
     assert "not an integer" in err
 
 
+def test_enable_elastic_requires_gang_scheduling(capsys):
+    from tf_operator_tpu.cli import main
+    with pytest.raises(SystemExit) as exc:
+        main(BASE + ["--enable-elastic"])
+    assert exc.value.code == 2
+    assert "--enable-gang-scheduling" in capsys.readouterr().err
+
+
+def test_enable_elastic_rejected_on_kube_backend(capsys):
+    """--enable-elastic on --backend kube must fail fast with a pointer
+    to the node-agent open item (ROADMAP item 1): a shrink's
+    save-before-evict barrier needs the notice/ack relay kubelet
+    cannot provide."""
+    from tf_operator_tpu.cli import main
+    with pytest.raises(SystemExit) as exc:
+        main(BASE + ["--enable-gang-scheduling", "--enable-elastic",
+                     "--backend", "kube"])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert "--enable-elastic" in err
+    assert "node" in err and "agent" in err
+
+
+def test_enable_elastic_wires_the_resize_pass():
+    args = build_parser().parse_args(BASE + [
+        "--enable-gang-scheduling", "--enable-elastic",
+        "--total-chips", "16"])
+    server = Server(args)
+    try:
+        gang = server.operator.controller.engine.gang
+        assert gang is not None and gang.elastic is True
+    finally:
+        server.shutdown()
+
+
+def test_elastic_off_by_default():
+    args = build_parser().parse_args(BASE + ["--enable-gang-scheduling"])
+    server = Server(args)
+    try:
+        assert server.operator.controller.engine.gang.elastic is False
+    finally:
+        server.shutdown()
+
+
 def test_version_wins_over_backend_validation(capsys):
     """`--version` prints and exits even when combined with flags that
     would otherwise fail validation (e.g. --backend none w/o api-port)."""

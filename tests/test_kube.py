@@ -497,11 +497,16 @@ class TestKubeSdk:
         for etype, j in sdk.watch(name="sdkjob", timeout=20,
                                   until_finished=True):
             events.append((etype, [c.type for c in j.status.conditions]))
-            phases = {p["status"]["phase"] for p in
-                      fake.state.list("pods", "default", "")["items"]}
-            if phases == {"Pending"}:
+            pods = fake.state.list("pods", "default", "")["items"]
+            phases = {p["status"]["phase"] for p in pods}
+            # Drive the fake kubelet only once the FULL gang exists: a
+            # watch event can legally arrive mid-creation (the
+            # workqueue's lost-wakeup fix made syncs prompt enough to
+            # observe it), and flipping a partial pod set would strand
+            # the late-created pod Pending forever.
+            if len(pods) == 2 and phases == {"Pending"}:
                 fake.state.set_all_pods_phase("default", "Running")
-            elif phases == {"Running"}:
+            elif len(pods) == 2 and phases == {"Running"}:
                 fake.state.set_all_pods_phase("default", "Succeeded")
         assert any("Succeeded" in conds for _, conds in events)
         assert sdk.is_job_succeeded("sdkjob")

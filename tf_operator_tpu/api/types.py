@@ -122,6 +122,12 @@ class JobConditionType:
     # preemptions are deferred until it answers again (flips to status
     # False on recovery). No reference analog.
     CONTROLPLANE_DEGRADED = "ControlPlaneDegraded"
+    # TPU extension (controller/gang.py resize pass, docs/elastic.md):
+    # an elastic resize (grow into idle capacity, or shrink under
+    # quota-reclaim/maintenance pressure) has been applied and the gang
+    # is restarting into the new world. Flips to status False once the
+    # gang is fully up at the new size. No reference analog.
+    RESIZING = "Resizing"
     RUNNING = "Running"
     RESTARTING = "Restarting"
     SUCCEEDED = "Succeeded"
@@ -480,12 +486,25 @@ class TPUSliceSpec(ApiObject):
     accelerator: e.g. "v5p-32", "v5e-16", "v4-8" (chips = suffix).
     topology:    optional explicit ICI mesh, e.g. "2x2x4"; derived from the
                  accelerator when omitted (bootstrap/topology.py).
-    num_slices:  >1 = multislice over DCN (megascale).
+    num_slices:  >1 = multislice over DCN (megascale). For an elastic gang
+                 this is the CURRENT/desired size, owned by the resize
+                 pass once minSlices/maxSlices opt in.
+    min_slices:  elastic floor (docs/elastic.md): the control plane may
+                 shrink the gang down to this many slices under quota
+                 reclaim or maintenance pressure instead of displacing
+                 it wholesale. None = not elastic-shrinkable.
+    max_slices:  elastic ceiling: the control plane may grow the gang
+                 into idle capacity up to this many slices. None = not
+                 elastic-growable. Both knobs require an accelerator
+                 (resizing is defined in whole slices) and take effect
+                 only under --enable-elastic.
     """
 
     accelerator: str = ""
     topology: str = ""
     num_slices: int = 1
+    min_slices: Optional[int] = None
+    max_slices: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -590,6 +609,12 @@ class SliceGroupStatus(ApiObject):
     # Restarting condition so restart-with-identity is visible on the
     # job; promotion back to Running clears it.
     displaced_reason: str = ""
+    # Why the resize pass last resized this group (e.g. "shrink to 2
+    # slice(s): QuotaReclaimed ..."); non-empty from the applied resize
+    # until the gang is fully up at the new size. The engine rolls it
+    # into the job's Resizing condition; it also serializes resizes —
+    # no second resize is applied while one is settling (gang.py).
+    resizing_reason: str = ""
 
 
 @dataclasses.dataclass

@@ -192,6 +192,26 @@ def _slice_errors(spec: TPUJobSpec):
                "'2x2x4'")
     if sl.num_slices < 1:
         yield "spec.slice.numSlices must be >= 1"
+    mn, mx = sl.min_slices, sl.max_slices
+    if mn is not None and mn < 1:
+        yield "spec.slice.minSlices must be >= 1"
+    if mx is not None and mx < 1:
+        yield "spec.slice.maxSlices must be >= 1"
+    if mn is not None and mx is not None and mx < mn:
+        yield (f"spec.slice.maxSlices ({mx}) must be >= minSlices ({mn})")
+    if mn is not None or mx is not None:
+        if not sl.accelerator:
+            # Resizing is defined in whole slices; without a declared
+            # slice shape there is no unit to grow or shrink by.
+            yield ("spec.slice.minSlices/maxSlices require "
+                   "spec.slice.accelerator (elastic resize operates on "
+                   "whole slices)")
+        if mn is not None and mn >= 1 and sl.num_slices < mn:
+            yield (f"spec.slice.numSlices ({sl.num_slices}) must be >= "
+                   f"minSlices ({mn})")
+        if mx is not None and mx >= 1 and sl.num_slices > mx:
+            yield (f"spec.slice.numSlices ({sl.num_slices}) must be <= "
+                   f"maxSlices ({mx})")
 
 
 def validate_tenant_queue(tq: TenantQueue) -> None:

@@ -119,9 +119,18 @@ def build_cluster_spec(job: TPUJob, rtype: str, index: int,
             cluster[repl_type] = [
                 f"{replica_dns_name(job, repl_type, index, domain)}:{port}"]
         else:
-            cluster[repl_type] = [
+            entries = [
                 f"{replica_dns_name(job, repl_type, i, domain)}:{port}"
                 for i in range(n)]
+            if repl_type == rt and index >= n:
+                # Transient out-of-range render (elastic grow before
+                # the spec settles): the view must contain THIS task —
+                # it exists by construction — and nothing between n and
+                # index, which does not exist yet.
+                entries.append(
+                    f"{replica_dns_name(job, repl_type, index, domain)}"
+                    f":{port}")
+            cluster[repl_type] = entries
     return ClusterSpec(cluster=cluster, task_type=rt, task_index=index)
 
 
@@ -218,8 +227,15 @@ def render_worker_env(job: TPUJob, rtype: str, index: int,
             n_workers = (job.spec.replica_specs[rt].replicas or 0)
             lo = slice_id * hps
             hi = min(lo + hps, max(n_workers, index + 1))
+            # Clamp to pods that exist: on a transient out-of-range
+            # render (elastic grow before the spec settles) the slice
+            # window would otherwise name workers between n_workers and
+            # index that have not been created yet — a worker handed
+            # such a view dials hosts that do not resolve. The pod's
+            # OWN name always belongs (it is the pod being rendered).
             slice_hosts = [replica_dns_name(job, rt, i, domain)
-                           for i in range(lo, hi)]
+                           for i in range(lo, hi)
+                           if i < n_workers or i == index]
             env["TPU_WORKER_ID"] = str(index % hps)
             env["TPU_WORKER_HOSTNAMES"] = ",".join(slice_hosts)
             if topo.num_slices > 1:

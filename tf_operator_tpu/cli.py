@@ -126,6 +126,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "ClusterQueues carry nominal chip quotas, cohort "
                         "borrowing, and reclaim (docs/quota.md). Off = "
                         "admission behavior identical to today")
+    p.add_argument("--enable-elastic", action="store_true",
+                   help="run the elastic resize pass (requires "
+                        "--enable-gang-scheduling): gangs whose "
+                        "spec.slice declares minSlices/maxSlices are "
+                        "grown into idle capacity and shrunk — instead "
+                        "of displaced — under quota reclaim or "
+                        "maintenance pressure, riding the world-resize "
+                        "restart with a resharded checkpoint restore "
+                        "(docs/elastic.md). Off = resize behavior "
+                        "identical to today")
     p.add_argument("--enable-ckpt-coordination", action="store_true",
                    help="run the CheckpointCoordinator: planned "
                         "disruptions (slice-health drains, quota "
@@ -276,7 +286,8 @@ class Server:
             queue_config=getattr(args, "queue_config", None),
             enable_ckpt_coordination=getattr(
                 args, "enable_ckpt_coordination", False),
-            enable_serving=getattr(args, "enable_serving", False))
+            enable_serving=getattr(args, "enable_serving", False),
+            enable_elastic=getattr(args, "enable_elastic", False))
         if getattr(args, "backend", "local") == "kube":
             # Cluster mode: the Store is the informer cache inside
             # KubeOperator; reads/writes/leases go to the K8s API.
@@ -482,6 +493,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.queue_config and not args.enable_tenant_queues:
         parser.error("--queue-config only makes sense with "
                      "--enable-tenant-queues")
+    if args.enable_elastic and not args.enable_gang_scheduling:
+        parser.error("--enable-elastic requires "
+                     "--enable-gang-scheduling: the resize pass is a "
+                     "gang-scheduler pass — without gang admission "
+                     "there is no slice accounting to resize against")
+    if args.enable_elastic and args.backend == "kube":
+        parser.error("--enable-elastic is not yet supported with "
+                     "--backend kube: a shrink's save-before-evict "
+                     "barrier needs the preemption-notice/ack relay "
+                     "that only the per-node agent can provide there "
+                     "(ROADMAP.md item 1, node agent); use the local "
+                     "or served backend")
     if args.enable_serving and args.backend == "kube":
         parser.error("--enable-serving is not yet supported with "
                      "--backend kube (the serving worker's spool and "

@@ -252,9 +252,24 @@ class CheckpointCoordinator:
                 if p.status.phase not in _TERMINAL_POD_PHASES]
 
     def _records(self, namespace: str, name: str) -> List[CheckpointRecord]:
-        return self.store.list(store_mod.CHECKPOINTRECORDS,
-                               namespace=namespace,
-                               selector={constants.LABEL_JOB_NAME: name})
+        """The job's CheckpointRecords, restricted to replicas of the
+        CURRENT world. An elastic shrink removes replica identities
+        permanently, and a doomed-but-still-running pod can publish a
+        record AFTER the resize pass pruned it (the data plane races
+        the prune) — an out-of-world record left in the ledger would
+        drag ``committed_step`` (the min over records) down to the
+        shrink point and make every later restore roll the gang back.
+        Filtering against the job spec is level-triggered and immune
+        to that race; ``prune_departed_records`` remains as storage
+        hygiene."""
+        records = self.store.list(store_mod.CHECKPOINTRECORDS,
+                                  namespace=namespace,
+                                  selector={constants.LABEL_JOB_NAME: name})
+        job = self.store.try_get(store_mod.TPUJOBS, namespace, name)
+        if job is None:
+            return records
+        return [r for r in records
+                if _record_in_world(job, r.metadata.name)]
 
     def _stamp_notices(self, pods: List[Pod], barrier: _Barrier) -> None:
         notice = json.dumps({
@@ -416,6 +431,25 @@ class CheckpointCoordinator:
         distributed checkpoint is only usable when all shards landed)."""
         return _committed_step(self._records(namespace, name))
 
+    def prune_departed_records(self, namespace: str, job_name: str,
+                               rtype: str, keep: int,
+                               up_to: int) -> None:
+        """Drop the CheckpointRecords of replicas an elastic shrink
+        removed from the world (indices ``keep``..``up_to``-1 of
+        ``rtype``). Records are keyed by pod name, so a departed
+        replica's record would otherwise linger forever and pin
+        ``committed_step`` (the min over records) at the shrink point —
+        every later restore would roll the surviving gang back to the
+        pre-shrink step. Called by the resize pass (controller/gang.py)
+        after the smaller world landed; level-triggered deletes, safe
+        to repeat."""
+        from tf_operator_tpu.api.types import gen_general_name
+
+        for index in range(keep, up_to):
+            self.store.try_delete(
+                store_mod.CHECKPOINTRECORDS, namespace,
+                gen_general_name(job_name, rtype, index))
+
     def restored_step(self, namespace: str, name: str) -> Optional[int]:
         steps = [r.status.restored_from_step
                  for r in self._records(namespace, name)
@@ -469,6 +503,27 @@ class CheckpointCoordinator:
                       msg: str) -> None:
         if self.recorder is not None and job is not None:
             self.recorder.event(job, etype, reason, msg)
+
+
+def _record_in_world(job: TPUJob, record_name: str) -> bool:
+    """Whether a record's replica identity ({job}-{rtype}-{index}, the
+    pod naming contract) exists in the job's CURRENT spec. Records with
+    unrecognized names are kept (fail open: better a conservative
+    committed step than dropping a live shard's ack)."""
+    prefix = job.metadata.name + "-"
+    if not record_name.startswith(prefix):
+        return True
+    rtype, sep, raw = record_name[len(prefix):].rpartition("-")
+    if not sep:
+        return True
+    try:
+        index = int(raw)
+    except ValueError:
+        return True
+    spec = job.spec.replica_specs.get(rtype)
+    if spec is None:
+        return True
+    return index < (spec.replicas or 0)
 
 
 def _committed_step(records: List[CheckpointRecord]) -> Optional[int]:

@@ -38,6 +38,10 @@ JOB_QUOTA_EXCEEDED_REASON = "QuotaExceeded"
 # was failing past the threshold / answered again.
 JOB_CONTROLPLANE_DEGRADED_REASON = "ControlPlaneDegraded"
 JOB_CONTROLPLANE_RECOVERED_REASON = "ControlPlaneRecovered"
+# TPU extensions (controller/gang.py resize pass): elastic-resize arc —
+# a grow/shrink was applied / the gang is fully up at the new size.
+JOB_RESIZING_REASON = "GangResizing"
+JOB_RESIZED_REASON = "GangResizeComplete"
 
 
 def _now() -> _dt.datetime:

@@ -160,6 +160,14 @@ class GangScheduler(abc.ABC):
         a quota subsystem report None."""
         return None
 
+    def resize_reason(self, job: TPUJob) -> Optional[str]:
+        """Non-empty while an elastic resize (controller/gang.py,
+        docs/elastic.md) has been applied to the job's gang and the new
+        world has not fully settled; the engine rolls it into the job's
+        Resizing condition. Schedulers without elastic resize report
+        None."""
+        return None
+
 
 @dataclass
 class EngineConfig:
@@ -337,6 +345,26 @@ class JobEngine:
                     f"drained ({displaced}); replicas will rebind on "
                     "spare capacity and resume from the latest "
                     "checkpoint")
+            # Elastic-resize arc (controller/gang.py, docs/elastic.md):
+            # Resizing while an applied grow/shrink is settling, then
+            # resolved to False once the gang is fully up at the new
+            # size. Level-triggered and quiet like the arcs above — the
+            # GangResized event and gang_resizes metric fire once at
+            # the resize edge in the scheduler.
+            resizing = self.gang.resize_reason(job)
+            if resizing:
+                cond.update_job_conditions(
+                    job.status, JobConditionType.RESIZING,
+                    cond.JOB_RESIZING_REASON,
+                    f"TPUJob {job.metadata.name} is resizing "
+                    f"({resizing}); replicas will rejoin the new world "
+                    "and resume from the latest checkpoint")
+            else:
+                cond.mark_condition_false(
+                    job.status, JobConditionType.RESIZING,
+                    cond.JOB_RESIZED_REASON,
+                    f"TPUJob {job.metadata.name} is fully up at its "
+                    "new size")
 
         # Checkpoint-coordination arc (controller/ckpt.py): surface an
         # in-flight save-before-evict barrier as a CheckpointBarrier

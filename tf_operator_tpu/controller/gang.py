@@ -24,6 +24,7 @@ from __future__ import annotations
 import datetime as _dt
 import logging
 import threading
+import time
 from typing import Dict, List, Optional
 
 from tf_operator_tpu.api import constants
@@ -31,6 +32,7 @@ from tf_operator_tpu.api.types import (
     ObjectMeta,
     Pod,
     ReplicaSpec,
+    ReplicaType,
     SliceGroup,
     SliceGroupSpec,
     SliceGroupStatus,
@@ -40,6 +42,10 @@ from tf_operator_tpu.controller.control import controller_owner_ref
 from tf_operator_tpu.controller.engine import GangScheduler
 from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.events import (
+    EVENT_TYPE_NORMAL,
+    REASON_GANG_RESIZED,
+)
 from tf_operator_tpu.runtime.store import Store
 
 log = logging.getLogger("tpu_operator.gang")
@@ -119,6 +125,17 @@ class SliceGangScheduler(GangScheduler):
     fully up: minMember live pods, tracked from pod state each sync)
     are never preempted; a Running group whose live count falls below
     minMember is demoted back to Inqueue and becomes preemptible again.
+
+    ``elastic`` (default off, docs/elastic.md) adds the resize pass:
+    gangs declaring spec.slice.minSlices/maxSlices are GROWN into idle
+    capacity (only when nothing feasible is waiting for it) and SHRUNK
+    — instead of displaced — when quota reclaim or a slice-health
+    drain needs their chips, riding the engine's world-restart +
+    restore-with-identity machinery, with shrinks gated on the
+    save-before-evict barrier. A gang is never resized below its
+    minSlices floor, and in-flight grows stay charged against the chip
+    budget until their group spec catches up, so the admitted-chips
+    invariant holds mid-resize.
     """
 
     def __init__(self, store: Store, total_chips: Optional[int] = None,
@@ -133,7 +150,10 @@ class SliceGangScheduler(GangScheduler):
                  draining_provider=None,
                  quota=None,
                  ckpt=None,
-                 cp_health=None):
+                 cp_health=None,
+                 elastic: bool = False,
+                 resize_signals=None,
+                 recorder=None):
         if fairness not in ("backfill", "strict", "aged"):
             raise ValueError(f"unknown gang fairness {fairness!r}")
         self.store = store
@@ -203,6 +223,37 @@ class SliceGangScheduler(GangScheduler):
         # by the kube backend so cluster eviction machinery respects
         # the gang's minMember; local backends have no evictor.
         self.pdb_control = None
+        # Elastic resize pass (docs/elastic.md): with elastic=True,
+        # gangs whose spec.slice declares minSlices/maxSlices are
+        # GROWN one slice at a time into idle capacity and SHRUNK
+        # (instead of displaced) when quota reclaim or a slice-health
+        # drain needs their chips — the resize mutates the job's slice
+        # count + coupled worker replicas and rides the engine's
+        # world-restart + restore-with-identity machinery. Off =
+        # behavior byte-identical to the pre-elastic scheduler.
+        self.elastic = elastic
+        # Optional resize-decision signal provider:
+        # (namespace, name) -> {signal: value}, e.g. serving_queue_depth
+        # for the future serving autoscaler (ROADMAP item 3a). The pass
+        # attaches the values to the resize record/event; it does not
+        # yet act on them.
+        self.resize_signals = resize_signals
+        # Optional event recorder (GangResized events).
+        self.recorder = recorder
+        # (ns, name) -> monotonic time the shrink first consulted the
+        # save-before-evict barrier (resize_barrier_seconds metric).
+        self._resize_barrier_t0: Dict[tuple, float] = {}
+        # (ns, name) -> (target slice count, extra chips) of grows
+        # planned but whose JOB-spec write has not been observed yet.
+        # A grow executes outside the scheduler lock, so without a
+        # charge two passes (or a pass and a pending admission) could
+        # spend the same free chips and over-admit once the groups
+        # sync. The ledger covers only the plan→write window; once the
+        # job spec carries the target, the persisted job-vs-group spec
+        # delta carries the charge (_elastic_inflight_extras) — which
+        # also survives an operator crash-restart, where the in-memory
+        # ledger does not (pinned by elastic chaos seed 100).
+        self._grow_inflight: Dict[tuple, tuple] = {}
         self._lock = threading.Lock()
         # Groups already flagged infeasible / unknown-priority (log once).
         self._warned_infeasible: set = set()
@@ -285,6 +336,9 @@ class SliceGangScheduler(GangScheduler):
                 # engine flips the job's Restarting condition back to
                 # Running.
                 group.status.displaced_reason = ""
+                if (group.status.resizing_reason
+                        and self._gang_settled(group, job, min_member)):
+                    group.status.resizing_reason = ""
                 self.store.update_status(store_mod.SLICEGROUPS, group)
                 log.info("slice group %s running (%d live pods)",
                          group.metadata.name, live)
@@ -295,6 +349,17 @@ class SliceGangScheduler(GangScheduler):
                 log.info("slice group %s lost pods (%d live < minMember "
                          "%d); demoted to Inqueue", group.metadata.name,
                          live, min_member)
+            elif (group.status.resizing_reason
+                    and self._gang_settled(group, job, min_member)):
+                # Resize arc complete: the gang is fully up at the NEW
+                # size (exact pod count — the job's stale tallies alone
+                # would clear the marker before the world restart even
+                # started). Clearing re-arms the resize pass and flips
+                # the job's Resizing condition back (engine.py).
+                group.status.resizing_reason = ""
+                self.store.update_status(store_mod.SLICEGROUPS, group)
+                log.info("slice group %s resize settled (%d live pods)",
+                         group.metadata.name, live)
 
     def _gang_live_in_store(self, group: SliceGroup,
                             min_member: int) -> bool:
@@ -306,6 +371,23 @@ class SliceGangScheduler(GangScheduler):
                 selector={constants.LABEL_JOB_NAME: group.metadata.name})
             if p.status.phase in ("Running", "Succeeded"))
         return live >= min_member
+
+    def _gang_settled(self, group: SliceGroup, job: TPUJob,
+                      min_member: int) -> bool:
+        """A resized gang has SETTLED when the store holds exactly the
+        desired pod count for the job's current spec and the gang is
+        running — i.e. the world restart finished and no stale pods of
+        the old size remain. Job-status tallies are not enough: right
+        after a shrink they still count the doomed pods."""
+        desired = sum(s.replicas or 0
+                      for s in job.spec.replica_specs.values())
+        pods = [p for p in self.store.list(
+                    store_mod.PODS, namespace=group.metadata.namespace,
+                    selector={constants.LABEL_JOB_NAME:
+                              group.metadata.name})
+                if p.status.phase not in ("Succeeded", "Failed")]
+        running = sum(1 for p in pods if p.status.phase == "Running")
+        return len(pods) == desired and running >= min_member
 
     def displace(self, namespace: str, name: str, reason: str) -> bool:
         """Slice-health drain hook (controller/health.py): push an
@@ -364,6 +446,302 @@ class SliceGangScheduler(GangScheduler):
         if group is None:
             return None
         return group.status.displaced_reason or None
+
+    def resize_reason(self, job: TPUJob) -> Optional[str]:
+        """Engine hook: non-empty while an elastic resize has been
+        applied to the job's gang and the new world has not fully
+        settled — rolled into the job's Resizing condition."""
+        group = self.store.try_get(store_mod.SLICEGROUPS,
+                                   job.metadata.namespace,
+                                   job.metadata.name)
+        if group is None:
+            return None
+        return group.status.resizing_reason or None
+
+    # -- elastic resize (docs/elastic.md) -------------------------------
+
+    def try_shrink(self, namespace: str, name: str, remove_slices: int,
+                   reason_label: str, message: str) -> Optional[bool]:
+        """Elastic shrink request (the slice-health controller's and
+        harnesses' entry point). Returns:
+
+        - ``None``  — not applicable: elastic off, the gang declares no
+          ``minSlices``, or removing ``remove_slices`` would go below
+          it. The caller falls back to its non-elastic path (full
+          drain / displacement).
+        - ``False`` — applicable but held: save-before-evict barrier in
+          flight, degraded control plane, or a previous resize still
+          settling. The caller's level-triggered pass retries; the
+          barrier timeout bounds the wait.
+        - ``True``  — the smaller world landed in the job spec; the
+          engine's restart-with-identity + restore path takes it from
+          here.
+        """
+        if not self.elastic or remove_slices <= 0:
+            return None
+        group = self.store.try_get(store_mod.SLICEGROUPS, namespace, name)
+        job = self.store.try_get(store_mod.TPUJOBS, namespace, name)
+        if group is None or job is None:
+            return None
+        sl = job.spec.slice
+        if not sl.accelerator or sl.min_slices is None:
+            return None
+        new_n = sl.num_slices - remove_slices
+        if new_n < sl.min_slices:
+            return None  # would go below the floor: not shrinkable
+        if group.status.resizing_reason:
+            return False  # previous resize still settling
+        return self._resize(namespace, name, new_n, "shrink",
+                            reason_label, message)
+
+    def _try_shrink_for_reclaim(self, namespace: str, name: str,
+                                chips_needed: int, reason: str):
+        """Quota reclaim prefers shrink-to-min over displacement:
+        returns (handled, landed). handled=False — the gang is not
+        elastic-shrinkable, the caller displaces as before.
+        handled=True, landed=False — a shrink is in flight (barrier /
+        degraded / settling): hold the displacement, the level-
+        triggered pass re-derives the remaining demand and retries."""
+        group = self.store.try_get(store_mod.SLICEGROUPS, namespace, name)
+        job = self.store.try_get(store_mod.TPUJOBS, namespace, name)
+        if group is None or job is None:
+            return False, False
+        if group.status.resizing_reason:
+            # A resize is still settling; displacing on top of it would
+            # double-disrupt the gang for chips already being freed.
+            return True, False
+        sl = job.spec.slice
+        mn = sl.min_slices
+        if not sl.accelerator or mn is None or sl.num_slices <= mn:
+            return False, False  # at (or below) the floor: displace
+        unit = _chips_per_slice(group)
+        if unit <= 0 or chips_needed <= 0:
+            return False, False
+        k = -(-chips_needed // unit)  # ceil: whole slices only
+        new_n = max(mn, sl.num_slices - k)
+        if new_n >= sl.num_slices:
+            return False, False
+        landed = self._resize(namespace, name, new_n, "shrink",
+                              "reclaim", reason)
+        return True, landed
+
+    def _plan_grows(self, groups: List[SliceGroup], cap: Optional[int],
+                    used: int, reserved: int, qpass) -> List[tuple]:
+        """Grow candidates for THIS pass (called under the scheduler
+        lock): fully-Running elastic gangs below maxSlices. Each grows
+        by as many slices as currently fit — one restart straight to
+        the biggest world the budget allows beats a ladder of restarts,
+        each of which rolls progress back to the committed step —
+        bounded by the remaining physical budget and, with tenant
+        queues on, quota eligibility for the incremental chips (growth
+        above nominal is borrowing and freezes like any other borrow
+        while a cohort nominal demand is unmet). Walk order is the
+        admission order, so higher-priority gangs claim idle capacity
+        first."""
+        free = None if cap is None else cap - used - reserved
+        out: List[tuple] = []
+        for g in groups:
+            if g.status.phase != PHASE_RUNNING or g.status.resizing_reason:
+                continue
+            sl = g.spec.slice
+            if not sl.accelerator or sl.max_slices is None:
+                continue
+            if sl.num_slices >= sl.max_slices:
+                continue
+            key = (g.metadata.namespace, g.metadata.name)
+            if key in self._grow_inflight:
+                continue  # a planned grow is still executing/syncing
+            job = self.store.try_get(store_mod.TPUJOBS, *key)
+            if job is None or job.spec.slice.num_slices != sl.num_slices:
+                continue  # resize in flight; wait for the sync to settle
+            unit = _chips_per_slice(g)
+            if unit <= 0:
+                continue
+            step = sl.max_slices - sl.num_slices
+            if free is not None:
+                step = min(step, free // unit)
+            while step > 0 and qpass is not None:
+                # Largest quota-eligible increment (borrow limits may
+                # cap below the physical headroom).
+                q_ok, _, _, _ = qpass.evaluate(g, unit * step)
+                if q_ok:
+                    break
+                step -= 1
+            if step <= 0:
+                continue
+            if free is not None:
+                free -= unit * step
+            self._grow_inflight[key] = (sl.num_slices + step, unit * step)
+            out.append((key[0], key[1], sl.num_slices + step))
+        return out
+
+    def _elastic_inflight_extras(self, groups: List[SliceGroup]
+                                 ) -> Dict[tuple, int]:
+        """(ns, name) -> extra chips an in-flight grow of that gang
+        already owns beyond its group spec. Two sources, never added
+        together:
+
+        - the PERSISTED job-vs-group slice delta (job spec grew, group
+          spec hasn't synced) — survives an operator crash-restart;
+        - the in-memory plan ledger, for the window between planning a
+          grow and observing its job-spec write.
+
+        Caller holds the scheduler lock. Entries whose job write has
+        been observed (or whose gang vanished) are pruned from the
+        ledger here."""
+        extras: Dict[tuple, int] = {}
+        live = set()
+        for g in groups:
+            key = (g.metadata.namespace, g.metadata.name)
+            live.add(key)
+            if g.status.phase not in (PHASE_INQUEUE, PHASE_RUNNING):
+                continue
+            sl = g.spec.slice
+            ledger = self._grow_inflight.get(key)
+            if (ledger is None and sl.max_slices is None
+                    and sl.min_slices is None):
+                continue  # not elastic: no job read, no charge
+            job = self.store.try_get(store_mod.TPUJOBS, *key)
+            if job is None:
+                self._grow_inflight.pop(key, None)
+                continue
+            unit = _chips_per_slice(g)
+            delta = max(0, job.spec.slice.num_slices
+                        - sl.num_slices) * unit
+            if ledger is not None:
+                target, chips = ledger
+                if job.spec.slice.num_slices >= target:
+                    # The job write landed: the persisted delta carries
+                    # the charge from here on.
+                    del self._grow_inflight[key]
+                else:
+                    delta = max(delta, chips)
+            if delta:
+                extras[key] = delta
+        for key in list(self._grow_inflight):
+            if key not in live:
+                del self._grow_inflight[key]
+        return extras
+
+    def _resize(self, namespace: str, name: str, new_slices: int,
+                direction: str, reason_label: str, message: str) -> bool:
+        """Apply ONE elastic resize: mutate the job's slice count (and
+        the coupled worker replica count) so the engine re-renders the
+        world — bootstrap digests change, live pods restart with
+        identity and resume from the committed checkpoint
+        (TPUJOB_RESTORE_STEP), out-of-range pods are deleted, missing
+        ones created. A shrink first completes a save-before-evict
+        barrier (controller/ckpt.py) so the smaller world restores from
+        a checkpoint that includes every doomed replica's shard, and
+        prunes the departed replicas' CheckpointRecords so they never
+        pin committed_step at the shrink point. Gated on degraded mode
+        like every other disruption. Returns True when the new world
+        landed in the spec."""
+        if (self.cp_health is not None
+                and not self.cp_health.allow_disruption("resize")):
+            return False
+        key = (namespace, name)
+        if direction == "shrink" and self.ckpt is not None:
+            self._resize_barrier_t0.setdefault(key, time.monotonic())
+            if not self.ckpt.ready_to_evict(
+                    namespace, name, f"elastic shrink ({message})"):
+                return False  # barrier in flight; retry next pass
+        scaled: Dict[str, tuple] = {}
+
+        def mutate(job):
+            sl = job.spec.slice
+            cur = sl.num_slices
+            if new_slices == cur or not sl.accelerator:
+                return False
+            mn = sl.min_slices if sl.min_slices is not None else 1
+            mx = sl.max_slices if sl.max_slices is not None else cur
+            if direction == "shrink" and new_slices < mn:
+                return False  # never below minSlices, even on re-read
+            if direction == "grow" and new_slices > max(mx, cur):
+                return False
+            from tf_operator_tpu.bootstrap.topology import (
+                parse_accelerator,
+            )
+
+            try:
+                topo = parse_accelerator(sl.accelerator, sl.topology,
+                                         max(1, cur))
+            except ValueError:
+                return False
+            worker = job.spec.replica_specs.get(ReplicaType.WORKER)
+            if (worker is not None and (worker.replicas or 0)
+                    == topo.hosts_per_slice * cur):
+                # The worker count tracks the slice count (one process
+                # per host). Templates with a custom worker shape keep
+                # their count; only the slice request changes.
+                scaled["workers"] = ((worker.replicas or 0),
+                                     topo.hosts_per_slice * new_slices)
+                worker.replicas = topo.hosts_per_slice * new_slices
+            sl.num_slices = new_slices
+            return None
+
+        from tf_operator_tpu.runtime import retry as retry_mod
+
+        job = retry_mod.update_with_conflict_retry(
+            self.store, store_mod.TPUJOBS, namespace, name, mutate,
+            component="gang.resize")
+        if job is None:
+            # Job vanished / resize no longer valid on fresh state:
+            # close the barrier episode we may have opened.
+            self._resize_barrier_t0.pop(key, None)
+            if direction == "shrink" and self.ckpt is not None:
+                self.ckpt.release(namespace, name)
+            return False
+        if direction == "shrink" and self.ckpt is not None:
+            self.ckpt.release(namespace, name)
+            old_w, new_w = scaled.get("workers", (0, 0))
+            if new_w < old_w:
+                self.ckpt.prune_departed_records(
+                    namespace, name, ReplicaType.WORKER, new_w, old_w)
+        t0 = self._resize_barrier_t0.pop(key, None)
+        if t0 is not None:
+            metrics.resize_barrier_seconds.observe(
+                max(0.0, time.monotonic() - t0), job_namespace=namespace)
+        detail = f"{direction} to {new_slices} slice(s): {message}"
+        signals = self._signal_values(namespace, name)
+        if signals:
+            detail += (" [signals: " + ", ".join(
+                f"{k}={v:g}" for k, v in sorted(signals.items())) + "]")
+
+        def mark(group):
+            group.status.resizing_reason = detail
+
+        retry_mod.update_with_conflict_retry(
+            self.store, store_mod.SLICEGROUPS, namespace, name, mark,
+            status=True, component="gang.resize")
+        metrics.gang_resizes.inc(direction=direction, reason=reason_label)
+        metrics.job_slices.set(new_slices, job_namespace=namespace,
+                               job=name)
+        log.info("resized gang %s/%s: %s", namespace, name, detail)
+        if self.recorder is not None:
+            try:
+                self.recorder.event(
+                    job, EVENT_TYPE_NORMAL, REASON_GANG_RESIZED,
+                    f"Gang {name} resized ({detail}); replicas rejoin "
+                    "the new world and resume from the latest "
+                    "checkpoint")
+            except Exception:
+                log.debug("GangResized event emit failed", exc_info=True)
+        return True
+
+    def _signal_values(self, namespace: str, name: str) -> Dict[str, float]:
+        """Resize-decision signals (e.g. serving_queue_depth) from the
+        optional provider — attached to the resize record/event so the
+        future serving autoscaler (ROADMAP item 3a) and humans reading
+        events see what the decision saw; the pass does not yet act on
+        them."""
+        if self.resize_signals is None:
+            return {}
+        try:
+            return dict(self.resize_signals(namespace, name) or {})
+        except Exception:
+            log.debug("resize signal provider failed", exc_info=True)
+            return {}
 
     def readmit(self) -> None:
         """Re-run admission off a capacity change (the binder calls this
@@ -450,6 +828,11 @@ class SliceGangScheduler(GangScheduler):
         drop an eviction or double-book the victim's chips."""
         now = _now()
         to_evict: List[tuple] = []
+        grows: List[tuple] = []
+        # True when some feasible pending group failed to admit this
+        # pass — idle capacity is then NOT idle (it is what the blocked
+        # group is waiting for) and the elastic grow pass stands down.
+        any_blocked = False
         with self._lock:
             # Effective chip budget for THIS pass: the static flag wins;
             # otherwise a bound capacity provider reports live cluster
@@ -500,6 +883,13 @@ class SliceGangScheduler(GangScheduler):
             occ_index = (self._occupancy_index()
                          if self.preemption or self.quota is not None
                          else {})
+            # Chips already committed to in-flight elastic grows whose
+            # group spec lags the job spec (or whose job write is still
+            # in flight): charged per group in the walk below so
+            # neither a pending admission nor another grow spends them
+            # twice.
+            grow_extras = (self._elastic_inflight_extras(groups)
+                           if self.elastic else {})
             for g in groups:
                 gk = (g.metadata.namespace, g.metadata.name)
                 occupied = g.status.phase in (PHASE_INQUEUE, PHASE_RUNNING)
@@ -508,7 +898,7 @@ class SliceGangScheduler(GangScheduler):
                     to_evict.append(gk)
                     occupied = True
                 if occupied:
-                    c = _chips_for(g)
+                    c = _chips_for(g) + grow_extras.get(gk, 0)
                     used += c
                     q = g.spec.queue or ""
                     queue_used[q] = queue_used.get(q, 0) + c
@@ -593,6 +983,7 @@ class SliceGangScheduler(GangScheduler):
                         passes_quota_lane = bp_ok and bp_borrow == 0
                     if not passes_quota_lane and (floor is None
                                                   or pri < floor):
+                        any_blocked = True
                         continue  # lane held for an earlier group
                 fits_phys = ((self._cap is None
                               or used + reserved + need <= self._cap)
@@ -623,6 +1014,7 @@ class SliceGangScheduler(GangScheduler):
                     if fits:
                         fits_phys = True
                     if not fits and ev_pending:
+                        any_blocked = True
                         # Chips are inbound for THIS group (victims died
                         # or are dying for it). Earmark them — lane block
                         # plus a global reservation — so no lower-priority
@@ -645,6 +1037,7 @@ class SliceGangScheduler(GangScheduler):
                             # the engine fails the job off the recorded
                             # wait state.
                             continue
+                    any_blocked = True
                     if self.fairness == "backfill":
                         continue  # pure skip: later groups may still fit
                     quota_only = fits_phys and not q_ok
@@ -698,6 +1091,12 @@ class SliceGangScheduler(GangScheduler):
                 # — the next pass re-derives them) but no borrower is
                 # displaced until evictions can actually be enforced.
                 reclaims = []
+            # Elastic grow pass: only when nothing feasible is waiting
+            # for capacity or quota (idle means idle) and no reclaim is
+            # about to free chips the grow would immediately re-take.
+            if self.elastic and not any_blocked and not reclaims:
+                grows = self._plan_grows(groups, self._cap, used,
+                                         reserved, qpass)
         # Pod deletes are API I/O on the kube backend — never under the
         # lock. Completed evictions free their chips on the next pass
         # (triggered by the pods' DELETED events re-enqueuing jobs);
@@ -713,14 +1112,39 @@ class SliceGangScheduler(GangScheduler):
         # Quota reclaim displacements: borrowed gangs go back through
         # admission (the slice-health re-admission path — original
         # priority, fresh aging window, level-triggered pod eviction)
-        # so a cohort member can take its nominal share back. Outside
-        # the lock: displace re-enters _admit.
-        for ns, name, qname, reason in reclaims:
+        # so a cohort member can take its nominal share back. Elastic
+        # gangs above their minSlices are SHRUNK by just the demanded
+        # chips instead — capacity loss as degradation, not failure
+        # (docs/elastic.md); at the floor they displace like everyone
+        # else. Outside the lock: displace/_resize re-enter _admit.
+        for ns, name, qname, reason, chips_needed in reclaims:
+            if self.elastic:
+                handled, landed = self._try_shrink_for_reclaim(
+                    ns, name, chips_needed, reason)
+                if handled:
+                    if landed and self.quota is not None:
+                        try:
+                            self.quota.note_reclaimed(qname, ns, name,
+                                                      reason)
+                        except Exception:
+                            log.debug("quota reclaim note failed",
+                                      exc_info=True)
+                    continue
             if self.displace(ns, name, reason) and self.quota is not None:
                 try:
                     self.quota.note_reclaimed(qname, ns, name, reason)
                 except Exception:
                     log.debug("quota reclaim note failed", exc_info=True)
+        # Elastic grows into idle capacity (the restart a grow triggers
+        # demotes the gang out of Running until it is back up, so
+        # growth is self-pacing). A grow that fails to land releases
+        # its budget charge immediately; a landed one stays charged
+        # until the group spec catches up (_elastic_inflight_extras).
+        for ns, name, new_n in grows:
+            if not self._resize(ns, name, new_n, "grow", "idle",
+                                "idle capacity available"):
+                with self._lock:
+                    self._grow_inflight.pop((ns, name), None)
 
     def _try_preempt(self, groups: List[SliceGroup], group: SliceGroup,
                      need: int, pri: int, q: str, quota: Optional[int],

@@ -65,7 +65,13 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from tf_operator_tpu.api import constants
-from tf_operator_tpu.api.types import HealthPolicy, Node, Pod, TPUJob
+from tf_operator_tpu.api.types import (
+    HealthPolicy,
+    Node,
+    Pod,
+    ReplicaType,
+    TPUJob,
+)
 from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod
 from tf_operator_tpu.runtime.events import (
@@ -327,6 +333,14 @@ class SliceHealthController:
                 # Gated BEFORE ready_to_evict so no barrier is opened
                 # that the controller may not be able to enforce.
                 continue
+            if self._try_elastic_shrink(ns, name, job, bad_pods, reasons):
+                # The gang rides out the capacity loss as a shrink
+                # (docs/elastic.md): only the doomed slices leave the
+                # world, the survivors restart into the smaller one and
+                # resume from the barrier-committed checkpoint. Either
+                # the shrink landed or its save barrier is in flight —
+                # both mean no full drain this pass.
+                continue
             if self.ckpt is not None and not self.ckpt.ready_to_evict(
                     ns, name, f"node degraded ({', '.join(reasons)})"):
                 # Save-before-evict barrier in flight: the gang is
@@ -336,6 +350,62 @@ class SliceHealthController:
                 # behind a wedged worker.
                 continue
             self._drain(ns, name, job, bad_pods, reasons)
+
+    def _try_elastic_shrink(self, ns: str, name: str, job: TPUJob,
+                            bad_pods: List[Pod],
+                            reasons: List[str]) -> bool:
+        """Prefer shrinking an elastic gang over draining it whole:
+        when every pod on the degraded node(s) is a worker and dropping
+        their slices keeps the gang at or above ``minSlices``, ask the
+        gang scheduler for a shrink by that many slices. True = handled
+        elastically (landed, or its save-before-evict barrier is still
+        in flight — the next health pass re-consults); False = not
+        applicable, fall back to the atomic full drain."""
+        gang = self.gang
+        if gang is None or not getattr(gang, "elastic", False):
+            return False
+        doomed = self._doomed_slices(job, bad_pods)
+        if doomed is None:
+            return False  # a coordinator-role pod is doomed: full drain
+        res = gang.try_shrink(ns, name, doomed, "drain",
+                              f"node degraded ({', '.join(reasons)})")
+        if res is None:
+            return False  # not elastic / would fall below minSlices
+        if res:
+            # Shrink landed: this degradation episode is answered — the
+            # survivors leave the degraded node via the world restart.
+            self._drain_first_seen.pop((ns, name), None)
+            self._warned_pending.discard((ns, name))
+        return True
+
+    def _doomed_slices(self, job: TPUJob,
+                       bad_pods: List[Pod]) -> Optional[int]:
+        """How many slices the degraded node(s) doom, or None when the
+        loss is not expressible as whole worker slices (a chief/ps pod
+        is affected, or an index is unparseable)."""
+        sl = job.spec.slice
+        if not sl.accelerator:
+            return None
+        from tf_operator_tpu.bootstrap.topology import parse_accelerator
+
+        try:
+            topo = parse_accelerator(sl.accelerator, sl.topology,
+                                     max(1, sl.num_slices))
+        except ValueError:
+            return None
+        hps = max(1, topo.hosts_per_slice)
+        doomed: set = set()
+        for p in bad_pods:
+            if (p.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "")
+                    != ReplicaType.WORKER):
+                return None
+            raw = p.metadata.labels.get(constants.LABEL_REPLICA_INDEX)
+            try:
+                index = int(raw)
+            except (TypeError, ValueError):
+                return None
+            doomed.add(index // hps)
+        return len(doomed) or None
 
     def _affected_groups(self, degraded: Dict[str, str]
                          ) -> Dict[Tuple[str, str], List[Pod]]:

@@ -345,13 +345,17 @@ class _QuotaPass:
 
     # -- pass end -------------------------------------------------------
 
-    def reclaims(self) -> List[Tuple[str, str, str, str]]:
-        """(namespace, name, queue, reason) of borrowed gangs to
-        displace so nominal demands can land. Victims are chosen from
+    def reclaims(self) -> List[Tuple[str, str, str, str, int]]:
+        """(namespace, name, queue, reason, chips_needed) of borrowed
+        gangs to displace so nominal demands can land — chips_needed is
+        the portion of the demander's unmet nominal this victim was
+        chosen to cover (the elastic resize pass shrinks by just that
+        much instead of displacing wholesale when the victim's gang
+        opted into minSlices; docs/elastic.md). Victims are chosen from
         over-nominal cohort members — lowest priority first, youngest
         first — honoring the demanding queue's reclaimPolicy; a queue
         is never reclaimed below its nominal."""
-        out: List[Tuple[str, str, str, str]] = []
+        out: List[Tuple[str, str, str, str, int]] = []
         if not self._reclaim_demands:
             return out
         usage = dict(self.usage)
@@ -395,13 +399,14 @@ class _QuotaPass:
                 taken.add(vk)
                 usage[vcq.metadata.name] = \
                     usage.get(vcq.metadata.name, 0) - c
+                covered = min(unmet, c)
                 unmet -= c
                 out.append((vk[0], vk[1], g.spec.queue,
                             f"QuotaReclaimed: cohort {cohort!r} demands "
                             f"{need} chips of queue "
                             f"{cq.metadata.name!r} nominal quota back "
                             f"from borrower queue "
-                            f"{vcq.metadata.name!r}"))
+                            f"{vcq.metadata.name!r}", covered))
         return out
 
     def finish(self) -> None:

@@ -49,6 +49,8 @@ class Operator:
                  queue_config: Optional[str] = None,
                  enable_ckpt_coordination: bool = False,
                  enable_serving: bool = False,
+                 enable_elastic: bool = False,
+                 resize_signals=None,
                  enable_slice_health: bool = False,
                  health_drain_grace_seconds: float = 0.0,
                  degraded_after_seconds: float = 10.0):
@@ -75,6 +77,10 @@ class Operator:
         if enable_slice_health and not enable_gang_scheduling:
             raise ValueError("slice health drains whole gangs: "
                              "--enable-slice-health requires "
+                             "--enable-gang-scheduling")
+        if enable_elastic and not enable_gang_scheduling:
+            raise ValueError("elastic resize is a gang-scheduler pass: "
+                             "--enable-elastic requires "
                              "--enable-gang-scheduling")
         if enable_ckpt_coordination:
             from tf_operator_tpu.controller.ckpt import (
@@ -115,7 +121,10 @@ class Operator:
                                       preemption=gang_preemption,
                                       quota=self.quota,
                                       ckpt=self.ckpt,
-                                      cp_health=self.cp_health)
+                                      cp_health=self.cp_health,
+                                      elastic=enable_elastic,
+                                      resize_signals=resize_signals,
+                                      recorder=self.recorder)
         self.controller = TPUJobController(self.store, recorder=self.recorder,
                                            config=config, gang=gang,
                                            namespace=namespace,

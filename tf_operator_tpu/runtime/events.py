@@ -54,6 +54,10 @@ REASON_BORROWED_CAPACITY = "BorrowedCapacity"
 REASON_QUOTA_RECLAIMED = "QuotaReclaimed"
 REASON_QUEUE_DELETED = "QueueDeleted"
 
+# Elastic-gang event reasons (controller/gang.py resize pass,
+# docs/elastic.md) — one event per applied grow/shrink.
+REASON_GANG_RESIZED = "GangResized"
+
 
 @dataclass
 class Event:

@@ -405,6 +405,22 @@ job_goodput_ratio = REGISTRY.gauge(
     "Fraction of a job's training steps NOT lost to disruptions: "
     "(progress - cumulative steps lost) / progress, 1.0 until the "
     "first loss", ["job_namespace", "job"])
+gang_resizes = REGISTRY.counter(
+    "tpu_operator_gang_resizes_total",
+    "Elastic gang resizes applied by the control plane, by direction "
+    "(grow|shrink) and reason (idle|reclaim|drain|manual|chaos)",
+    ["direction", "reason"])
+job_slices = REGISTRY.gauge(
+    "tpu_operator_job_slices",
+    "Current slice count of an elastic gang, updated at every applied "
+    "resize (docs/elastic.md)", ["job_namespace", "job"])
+resize_barrier_seconds = REGISTRY.histogram(
+    "tpu_operator_resize_barrier_seconds",
+    "Shrink decision to save-barrier release: how long an elastic "
+    "shrink waited for the gang's final checkpoint acks before the "
+    "smaller world was rendered", ["job_namespace"],
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0))
 api_retries = REGISTRY.counter(
     "tpu_operator_api_retries_total",
     "In-place retries of transient API failures (runtime/retry.py "

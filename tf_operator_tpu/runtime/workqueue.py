@@ -17,6 +17,16 @@ job_controller.go:139-142. Semantics preserved:
 - ``add_after`` schedules a delayed add (used for ActiveDeadlineSeconds
   and TTL re-sync, reference status.go:84-92, job.go:345-357).
 
+Wakeups: ``get()`` waiters and the delay loop wait on SEPARATE
+conditions sharing one mutex. They used to share a single condition,
+and ``add``'s lone ``notify()`` could wake the delay loop instead of a
+``get()`` waiter — the freshly queued item then sat until a worker's
+poll timeout (~0.5 s of sync latency per quiet-period add; masked by
+event churn, exposed by the elastic resize pass's steady-state grows).
+``notify_all`` is not the fix either: waking every worker and the
+delay loop on every add is a thundering herd that starves the
+process's other threads (watch streams, servers) under event storms.
+
 Observability lives HERE, under the queue's own lock (the depth gauge
 used to be set racily at the two controller call sites):
 ``workqueue_depth`` on every transition, ``workqueue_latency_seconds``
@@ -42,7 +52,12 @@ class ShutDown(Exception):
 class RateLimitingQueue:
     def __init__(self, base_delay: float = 0.005, max_delay: float = 30.0,
                  instrument: bool = True):
-        self._lock = threading.Condition()
+        # One mutex, two wait channels: ``_items`` for get() waiters,
+        # ``_delay_cv`` for the delay loop — a ready-item notify can
+        # only ever wake a consumer (see module docstring).
+        self._mutex = threading.Lock()
+        self._items = threading.Condition(self._mutex)
+        self._delay_cv = threading.Condition(self._mutex)
         self._queue: deque = deque()
         self._dirty: set = set()
         self._processing: set = set()
@@ -60,7 +75,7 @@ class RateLimitingQueue:
                                               daemon=True)
         self._delay_thread.start()
 
-    # -- instrumentation (callers hold self._lock) -------------------------
+    # -- instrumentation (callers hold self._mutex) ------------------------
 
     def _mark_queued(self, item: Hashable) -> None:
         self._queue.append(item)
@@ -78,7 +93,7 @@ class RateLimitingQueue:
     # -- core queue -------------------------------------------------------
 
     def add(self, item: Hashable) -> None:
-        with self._lock:
+        with self._items:
             if self._shutting_down:
                 return
             if item in self._dirty:
@@ -88,12 +103,12 @@ class RateLimitingQueue:
             if item in self._processing:
                 return  # re-queued by done()
             self._mark_queued(item)
-            self._lock.notify()
+            self._items.notify()
 
     def get(self, timeout: Optional[float] = None) -> Hashable:
         """Block until an item is available. Raises ShutDown when drained
         after shutdown, or TimeoutError on timeout."""
-        with self._lock:
+        with self._items:
             deadline = None if timeout is None else time.monotonic() + timeout
             while not self._queue:
                 if self._shutting_down:
@@ -101,7 +116,7 @@ class RateLimitingQueue:
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     raise TimeoutError()
-                self._lock.wait(remaining)
+                self._items.wait(remaining)
             item = self._queue.popleft()
             self._processing.add(item)
             self._dirty.discard(item)
@@ -113,38 +128,39 @@ class RateLimitingQueue:
             return item
 
     def done(self, item: Hashable) -> None:
-        with self._lock:
+        with self._items:
             self._processing.discard(item)
             if item in self._dirty:
                 self._mark_queued(item)
-                self._lock.notify()
+                self._items.notify()
 
     def __len__(self) -> int:
-        with self._lock:
+        with self._mutex:
             return len(self._queue)
 
     def shutdown(self) -> None:
-        with self._lock:
+        with self._mutex:
             self._shutting_down = True
-            self._lock.notify_all()
+            self._items.notify_all()
+            self._delay_cv.notify_all()
 
     @property
     def shutting_down(self) -> bool:
-        with self._lock:
+        with self._mutex:
             return self._shutting_down
 
     # -- rate limiting ----------------------------------------------------
 
     def num_requeues(self, item: Hashable) -> int:
-        with self._lock:
+        with self._mutex:
             return self._failures.get(item, 0)
 
     def forget(self, item: Hashable) -> None:
-        with self._lock:
+        with self._mutex:
             self._failures.pop(item, None)
 
     def add_rate_limited(self, item: Hashable) -> None:
-        with self._lock:
+        with self._mutex:
             n = self._failures.get(item, 0)
             self._failures[item] = n + 1
         delay = min(self._base_delay * (2 ** n), self._max_delay)
@@ -154,17 +170,17 @@ class RateLimitingQueue:
         if delay <= 0:
             self.add(item)
             return
-        with self._lock:
+        with self._delay_cv:
             if self._shutting_down:
                 return
             self._seq += 1
             heapq.heappush(self._delayed, (time.monotonic() + delay,
                                            self._seq, item))
-            self._lock.notify_all()
+            self._delay_cv.notify()  # re-arm the loop's wait window
 
     def _delay_loop(self) -> None:
         while True:
-            with self._lock:
+            with self._delay_cv:
                 if self._shutting_down and not self._delayed:
                     return
                 now = time.monotonic()
@@ -174,10 +190,10 @@ class RateLimitingQueue:
                         self._dirty.add(item)
                         if item not in self._processing:
                             self._mark_queued(item)
-                            self._lock.notify()
+                            self._items.notify()
                     else:
                         self._coalesced()
                 wait = 0.2
                 if self._delayed:
                     wait = min(wait, max(0.0, self._delayed[0][0] - now))
-                self._lock.wait(wait)
+                self._delay_cv.wait(wait)
