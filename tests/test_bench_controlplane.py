@@ -24,11 +24,20 @@ ARTIFACT_KEYS = {
     "convergence_seconds", "jobs_per_sec", "syncs", "syncs_per_sec",
     "reconcile_p50_ms", "reconcile_p99_ms", "deepcopies_per_sync",
     "jobs", "workers_per_job", "pods", "threadiness",
+    "tracing", "phase_attribution",
     "env", "config_fingerprint",
 }
 
 ENV_KEYS = {"python", "machine", "system", "jax_version", "platform",
             "chip_kind"}
+
+# The phase-attribution block (flight recorder, docs/observability.md):
+# every key a "where did the time go" diff reads round-over-round.
+PHASE_KEYS = {
+    "queue_wait_s", "sync_s", "api_retry_s", "barrier_wait_s",
+    "binder_s", "sync_breakdown_s", "sync_attributed_pct",
+    "wallclock_attributed_pct",
+}
 
 
 def test_smoke_run_converges_and_reports():
@@ -40,6 +49,30 @@ def test_smoke_run_converges_and_reports():
     assert result["jobs_per_sec"] > 0
     assert result["syncs"] >= 5  # at least one sync per job
     assert result["reconcile_p99_ms"] >= result["reconcile_p50_ms"]
+    # Tracing on by default: the phase-attribution block is present,
+    # schema-pinned, and actually attributes the sync path.
+    assert result["tracing"] is True
+    pa = result["phase_attribution"]
+    assert PHASE_KEYS <= set(pa)
+    assert pa["sync_s"] > 0
+    assert pa["queue_wait_s"] > 0
+    assert set(pa["sync_breakdown_s"]) == set(
+        bench_controlplane.SYNC_BREAKDOWN_SPANS)
+    assert sum(pa["sync_breakdown_s"].values()) > 0
+    assert 0 < pa["sync_attributed_pct"] <= 100
+    # The recorder must be disabled again after the run (no bleed into
+    # other scenarios or tests).
+    from tf_operator_tpu.runtime import trace
+
+    assert not trace.enabled()
+
+
+def test_no_trace_run_omits_phase_block():
+    result = bench_controlplane.run_bench(jobs=3, workers=2,
+                                          threadiness=4, timeout=30.0,
+                                          trace=False)
+    assert result["tracing"] is False
+    assert "phase_attribution" not in result
 
 
 def test_artifact_is_one_json_line_with_pinned_schema(capsys):

@@ -54,7 +54,6 @@ from tf_operator_tpu.controller.ckpt import (
 )
 from tf_operator_tpu.controller.gang import (
     PHASE_INQUEUE,
-    PHASE_PENDING,
     SliceGangScheduler,
 )
 from tf_operator_tpu.controller.health import SliceHealthController

@@ -199,6 +199,169 @@ def test_unknown_path_404(server):
     assert ei.value.code == 404
 
 
+# --- flight-recorder endpoints (runtime/trace.py; docs/observability.md) --
+
+@pytest.fixture()
+def trace_state():
+    from tf_operator_tpu.runtime import trace
+
+    trace.reset_for_tests()
+    yield trace
+    trace.reset_for_tests()
+
+
+def _get_raw(server, path):
+    req = urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}{path}", timeout=5)
+    with req as resp:
+        return resp.status, resp.headers.get("Content-Type"), \
+            resp.read().decode()
+
+
+def test_debug_traces_empty_recorder_shape(server, trace_state):
+    """Tracing off: /debug/traces stays served — enabled false, no
+    traces, zero seen — with a JSON content type."""
+    status, ctype, body = _get_raw(server, "/debug/traces")
+    assert status == 200
+    assert ctype == "application/json"
+    payload = json.loads(body)
+    assert payload["enabled"] is False
+    assert payload["traces"] == []
+    assert payload["traces_seen"] == 0
+    assert payload["retained"] == {"slowest": 0, "errored": 0,
+                                   "sampled": 0}
+    assert payload["phase_totals_s"] == {}
+
+
+def test_debug_traces_serves_slow_sync_retention(server, trace_state):
+    """A deliberately slow sync is retained by the slowest-N policy and
+    visible over HTTP with its child spans."""
+    import time as _time
+
+    trace_state.configure(True)
+    with trace_state.span("sync", job="default/slow"):
+        with trace_state.span("pods.list"):
+            _time.sleep(0.02)
+    for _ in range(5):
+        with trace_state.span("sync", job="default/fast"):
+            pass
+    _, _, body = _get_raw(server, "/debug/traces")
+    payload = json.loads(body)
+    assert payload["enabled"] is True
+    assert payload["traces_seen"] == 6
+    slowest = payload["traces"][0]
+    assert slowest["spans"][-1]["attrs"]["job"] == "default/slow"
+    assert {s["name"] for s in slowest["spans"]} == {"sync", "pods.list"}
+    assert slowest["duration_ms"] >= 20
+
+
+def test_debug_jobs_unknown_job_404s(server, trace_state):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server, "/debug/jobs/default/ghost")
+    assert ei.value.code == 404
+    assert "decision journal" in json.loads(ei.value.read().decode())[
+        "error"]
+    # Malformed paths 404 too (no namespace/name split).
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server, "/debug/jobs/onlyns")
+    assert ei.value.code == 404
+
+
+def test_debug_jobs_serves_decision_journal_shape(server, trace_state):
+    trace_state.JOURNAL.record("default", "j1", "admission.defer",
+                               "capacity", "needs 8 chips; 4/4 in use")
+    trace_state.JOURNAL.record("default", "j1", "admission.admit",
+                               "admitted", "8 chips")
+    status, ctype, body = _get_raw(server, "/debug/jobs/default/j1")
+    assert status == 200
+    assert ctype == "application/json"
+    payload = json.loads(body)
+    assert payload["namespace"] == "default"
+    assert payload["name"] == "j1"
+    assert [d["kind"] for d in payload["decisions"]] == [
+        "admission.defer", "admission.admit"]
+    for d in payload["decisions"]:
+        assert {"seq", "time", "last_time", "kind", "reason", "message",
+                "trace_id", "span", "count"} <= set(d)
+
+
+def test_tracing_off_is_shared_noop_and_records_nothing(trace_state):
+    """The zero-overhead contract: disabled, span() allocates nothing
+    (it returns the one shared no-op object) and a full sync leaves the
+    recorder untouched."""
+    from tf_operator_tpu.controller.tpu_controller import TPUJobController
+    from tf_operator_tpu.runtime import store as store_mod
+    from tf_operator_tpu.testutil import new_tpujob
+
+    assert trace_state.span("sync") is trace_state.span("pods.list") \
+        is trace_state.NOOP_SPAN
+    store = Store()
+    controller = TPUJobController(store)
+    job = new_tpujob(worker=1, name="untraced")
+    store.create(store_mod.TPUJOBS, job)
+    controller.sync_tpujob("default/untraced")
+    assert trace_state.RECORDER.snapshot()["traces_seen"] == 0
+    assert trace_state.RECORDER.phase_totals() == {}
+    store.stop_watchers()
+
+
+# --- metric cardinality: job-labeled series pruned by job GC --------------
+
+def test_metric_remove_drops_child_series():
+    r = Registry()
+    g = r.gauge("job_gauge", "h", ["job_namespace", "job"])
+    g.set(0.5, job_namespace="ns", job="a")
+    g.set(0.9, job_namespace="ns", job="b")
+    g.remove(job_namespace="ns", job="a")
+    g.remove(job_namespace="ns", job="never-existed")  # no-op
+    text = r.render_text()
+    assert 'job="a"' not in text
+    assert 'job="b"' in text
+    h = r.histogram("job_hist", "h", ["job"], buckets=(1.0,))
+    h.observe(0.5, job="a")
+    h.remove(job="a")
+    assert 'job="a"' not in r.render_text()
+
+
+def test_job_gc_prunes_job_labeled_series_and_journal(trace_state):
+    """Create -> delete a job through the controller's watch path; its
+    goodput/slices series must leave render_text() and its decision
+    journal must forget it (unbounded cardinality fix)."""
+    import time as _time
+
+    from tf_operator_tpu.controller.tpu_controller import TPUJobController
+    from tf_operator_tpu.runtime import metrics as mx
+    from tf_operator_tpu.runtime import store as store_mod
+    from tf_operator_tpu.runtime.metrics import REGISTRY
+    from tf_operator_tpu.testutil import new_tpujob
+
+    store = Store()
+    controller = TPUJobController(store)
+    controller.start_watching()
+    try:
+        job = new_tpujob(worker=1, name="gc-job")
+        store.create(store_mod.TPUJOBS, job)
+        mx.job_goodput_ratio.set(0.75, job_namespace="default",
+                                 job="gc-job")
+        mx.job_slices.set(2, job_namespace="default", job="gc-job")
+        trace_state.JOURNAL.record("default", "gc-job",
+                                   "admission.admit", "admitted", "m")
+        assert 'job="gc-job"' in REGISTRY.render_text()
+        store.delete(store_mod.TPUJOBS, "default", "gc-job")
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline:
+            if ('job="gc-job"' not in REGISTRY.render_text()
+                    and trace_state.JOURNAL.decisions(
+                        "default", "gc-job") is None):
+                break
+            _time.sleep(0.01)
+        assert 'job="gc-job"' not in REGISTRY.render_text()
+        assert trace_state.JOURNAL.decisions("default", "gc-job") is None
+    finally:
+        controller.stop()
+        store.stop_watchers()
+
+
 # --- structured logging --------------------------------------------------
 
 def test_json_formatter_fields():

@@ -10,7 +10,7 @@ import time
 
 import pytest
 
-from tf_operator_tpu.api.types import ObjectMeta, Pod, TPUJob
+from tf_operator_tpu.api.types import ObjectMeta, Pod
 from tf_operator_tpu.runtime import metrics, store as store_mod
 from tf_operator_tpu.runtime.chaos import (
     ChaosStore,

@@ -197,9 +197,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "window); a job's healthPolicy."
                         "drainGraceSeconds overrides it")
     p.add_argument("--monitoring-port", type=int, default=8443,
-                   help="port for /metrics, /healthz "
+                   help="port for /metrics, /healthz, /debug/traces, "
+                        "/debug/jobs/<ns>/<name> "
                         "(0 = disabled, -1 = ephemeral)")
     p.add_argument("--monitoring-host", default="127.0.0.1")
+    p.add_argument("--enable-tracing", action="store_true",
+                   help="record reconcile-path spans into the flight "
+                        "recorder: /debug/traces serves the slowest/"
+                        "errored/sampled sync traces and per-phase "
+                        "totals (docs/observability.md). Off = the "
+                        "span API is a shared no-op (near-zero cost); "
+                        "/debug/traces stays served but empty. The "
+                        "per-job decision journal at /debug/jobs/... "
+                        "is always on")
+    p.add_argument("--trace-file", default=None,
+                   help="(with --enable-tracing) append every completed "
+                        "trace as one JSON line to this file — the "
+                        "offline counterpart of /debug/traces "
+                        "(docs/observability.md 'Trace-file format')")
     p.add_argument("--api-port", type=int, default=0,
                    help="serve the control-plane API on this port "
                         "(0 = disabled, -1 = ephemeral); remote SDK "
@@ -270,6 +285,15 @@ class Server:
         # thread, never on the elector's own thread.
         self.on_fatal = on_fatal
         self._lease_store = None
+        # Flight recorder (runtime/trace.py): spans are process-global
+        # like the metrics registry, so wiring happens at assembly, not
+        # per subsystem. Off (the default) the span API is a shared
+        # no-op object — no allocation on the reconcile hot path.
+        from tf_operator_tpu.runtime import trace as trace_lib
+
+        trace_lib.configure(
+            enabled=getattr(args, "enable_tracing", False),
+            trace_file=getattr(args, "trace_file", None))
         gang_kwargs = dict(
             enable_gang_scheduling=args.enable_gang_scheduling,
             total_chips=args.total_chips,

@@ -48,6 +48,7 @@ from tf_operator_tpu.controller.health import (
 )
 from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime import trace as trace_mod
 from tf_operator_tpu.runtime.events import (
     EVENT_TYPE_NORMAL,
     EVENT_TYPE_WARNING,
@@ -193,6 +194,14 @@ class SliceGangBinder:
     def bind_pass(self) -> int:
         """Re-derive inventory + demand from the cache and bind what the
         admission gate allows. Returns the number of binds issued."""
+        # Flight-recorder "binder" phase: each pass is a trace of its
+        # own (the binder runs on its own thread, never inside a sync).
+        with trace_mod.span("binder.pass") as sp:
+            binds = self._bind_pass()
+            sp.set(binds=binds)
+            return binds
+
+    def _bind_pass(self) -> int:
         nodes = self.store.list(store_mod.NODES)
         sig = tuple(sorted(
             (n.metadata.name, n.spec.chips, node_is_schedulable(n))

@@ -70,6 +70,7 @@ from tf_operator_tpu.api.types import (
 from tf_operator_tpu.controller import conditions as cond
 from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime import trace as trace_mod
 from tf_operator_tpu.runtime.events import (
     EVENT_TYPE_NORMAL,
     EVENT_TYPE_WARNING,
@@ -196,6 +197,12 @@ class CheckpointCoordinator:
         barrier completed (full-gang ack or timeout). False means a
         barrier is in flight; the caller retries on its next
         level-triggered pass and the timeout bounds the wait."""
+        with trace_mod.span("ckpt.barrier_consult",
+                            job=f"{namespace}/{name}"):
+            return self._ready_to_evict(namespace, name, reason)
+
+    def _ready_to_evict(self, namespace: str, name: str,
+                        reason: str) -> bool:
         job = self.store.try_get(store_mod.TPUJOBS, namespace, name)
         policy = job_checkpoint_policy(job)
         if policy is None:
@@ -223,6 +230,12 @@ class CheckpointCoordinator:
                     f"Save-before-evict barrier opened ({reason}); "
                     f"evicting after full-gang checkpoint ack or "
                     f"{policy.barrier_timeout_seconds:.0f}s")
+                trace_mod.JOURNAL.record(
+                    namespace, name, "barrier.open", "save-before-evict",
+                    f"barrier {barrier.id} opened ({reason}); evicting "
+                    "after full-gang checkpoint ack or "
+                    f"{policy.barrier_timeout_seconds:.0f}s",
+                    barrier=barrier.id)
             # Stamp the notice level-triggered: pods missed on an earlier
             # pass (conflicts, stragglers the engine just recreated) get
             # it on this one.
@@ -373,6 +386,16 @@ class CheckpointCoordinator:
         self._lost_steps[key] = self._lost_steps.get(key, 0) + lost
         self._publish_goodput(key, progress)
         elapsed = self.clock() - barrier.started
+        # Phase attribution: open->resolve elapsed is the disruption's
+        # "barrier_wait" — the time capacity reclaim spent waiting on
+        # final saves (runtime/trace.py; docs/observability.md).
+        trace_mod.note_phase("barrier_wait", max(0.0, elapsed))
+        trace_mod.JOURNAL.record(
+            key[0], key[1], "barrier.resolved", outcome,
+            f"barrier {barrier.id} {outcome} after {elapsed:.2f}s "
+            f"({len(barrier.acked)}/{len(barrier.stamped)} acks, "
+            f"committed step {committed}, ~{lost} step(s) lost)",
+            barrier=barrier.id, committed=committed, lost=lost)
         if outcome == OUTCOME_ACKED:
             log.info("checkpoint barrier %s for %s/%s: full-gang ack at "
                      "step %s in %.2fs; releasing eviction", barrier.id,

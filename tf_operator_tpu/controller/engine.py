@@ -52,6 +52,7 @@ from tf_operator_tpu.controller.expectations import (
 )
 from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import retry as retry_mod
+from tf_operator_tpu.runtime import trace as trace_mod
 from tf_operator_tpu.runtime.events import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING, Recorder
 from tf_operator_tpu.runtime.workqueue import RateLimitingQueue
 
@@ -231,14 +232,20 @@ class JobEngine:
         run_policy = job.spec.run_policy
         job_key = job.key()
 
-        pods = self.plugin.get_pods_for_job(job)
-        endpoints = self.plugin.get_endpoints_for_job(job)
+        # Flight-recorder phases (runtime/trace.py): the sync's store
+        # reads, gang/quota pass, replica diffing, and status writes
+        # are each a child span of the sync root, so a slow sync at
+        # /debug/traces says WHICH leg was slow.
+        with trace_mod.span("pods.list"):
+            pods = self.plugin.get_pods_for_job(job)
+            endpoints = self.plugin.get_endpoints_for_job(job)
         old_status = job.status.deepcopy()
 
         if cond.is_finished(job.status):
-            self._finalize_finished_job(job, pods)
-            if job.status.to_dict() != old_status.to_dict():
-                self.plugin.update_job_status_in_api(job)
+            with trace_mod.span("finalize"):
+                self._finalize_finished_job(job, pods)
+                if job.status.to_dict() != old_status.to_dict():
+                    self.plugin.update_job_status_in_api(job)
             return
 
         previous_retry = self.workqueue.num_requeues(job_key)
@@ -289,7 +296,8 @@ class JobEngine:
 
         # General path.
         if self.config.enable_gang_scheduling and self.gang:
-            self.gang.sync_slice_group(job, replica_specs)
+            with trace_mod.span("gang.sync"):
+                self.gang.sync_slice_group(job, replica_specs)
             # Tenant-queue quota arc (controller/quota.py): while the
             # gang is quota-held, the job carries a Queued condition;
             # on admission it resolves to False; a wait that can never
@@ -374,7 +382,8 @@ class JobEngine:
         # condition machinery no-ops on re-assert and the change diff
         # below decides whether anything is written.
         if self.ckpt is not None:
-            self.ckpt.sync_job_status(job)
+            with trace_mod.span("ckpt.sync"):
+                self.ckpt.sync_job_status(job)
 
         # Degraded-mode surfacing (runtime/retry.py ControlPlaneHealth):
         # while the API server has been failing past the threshold, the
@@ -399,15 +408,19 @@ class JobEngine:
                     "The operator's API server is reachable again; "
                     "disruptive actions resumed")
 
-        for rtype, spec in replica_specs.items():
-            self.reconcile_pods(job, pods, rtype, spec, replica_specs)
-            self.reconcile_endpoints(job, endpoints, rtype, spec)
+        with trace_mod.span("reconcile.replicas"):
+            for rtype, spec in replica_specs.items():
+                self.reconcile_pods(job, pods, rtype, spec, replica_specs)
+                self.reconcile_endpoints(job, endpoints, rtype, spec)
 
         # Thread the snapshot this sync already listed+claimed through
         # the status roll-up — update_job_status used to re-list and
         # re-claim, doubling the per-sync store cost for nothing.
-        self.plugin.update_job_status(job, replica_specs, pods)
-        if job.status.to_dict() != old_status.to_dict():
+        with trace_mod.span("status.rollup"):
+            self.plugin.update_job_status(job, replica_specs, pods)
+        with trace_mod.span("status.diff"):
+            changed = job.status.to_dict() != old_status.to_dict()
+        if changed:
             self.plugin.update_job_status_in_api(job)
 
     def _finalize_finished_job(self, job: TPUJob, pods: List[Pod]) -> None:

@@ -42,6 +42,7 @@ from tf_operator_tpu.controller.control import controller_owner_ref
 from tf_operator_tpu.controller.engine import GangScheduler
 from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime import trace as trace_mod
 from tf_operator_tpu.runtime.events import (
     EVENT_TYPE_NORMAL,
     REASON_GANG_RESIZED,
@@ -400,6 +401,10 @@ class SliceGangScheduler(GangScheduler):
         (admission orders by creation time — see _admit). The
         displaced_reason marker surfaces as the job's Restarting
         condition (engine.py) until the gang runs again."""
+        with trace_mod.span("gang.displace", job=f"{namespace}/{name}"):
+            return self._displace(namespace, name, reason)
+
+    def _displace(self, namespace: str, name: str, reason: str) -> bool:
         group = self.store.try_get(store_mod.SLICEGROUPS, namespace, name)
         if group is None or group.status.phase == PHASE_PENDING:
             return False
@@ -409,6 +414,11 @@ class SliceGangScheduler(GangScheduler):
             # would open a checkpoint barrier (or delete pods) it may
             # never be able to enforce; the caller's level-triggered
             # pass retries once the API server answers again.
+            trace_mod.JOURNAL.record(
+                namespace, name, "disruption.deferred",
+                "controlplane-degraded",
+                f"displacement ({reason}) deferred: the API server is "
+                "degraded (docs/robustness.md)")
             return False
         if self.ckpt is not None and not self.ckpt.ready_to_evict(
                 namespace, name, reason):
@@ -434,6 +444,9 @@ class SliceGangScheduler(GangScheduler):
         log.info("displaced slice group %s/%s (%s); re-entering "
                  "admission at original priority", namespace, name,
                  reason)
+        trace_mod.JOURNAL.record(
+            namespace, name, "displaced", "drain",
+            f"gang displaced back through admission: {reason}")
         self._admit()  # freed chips may admit it (or others) right away
         return True
 
@@ -637,8 +650,21 @@ class SliceGangScheduler(GangScheduler):
         pin committed_step at the shrink point. Gated on degraded mode
         like every other disruption. Returns True when the new world
         landed in the spec."""
+        with trace_mod.span("gang.resize", job=f"{namespace}/{name}",
+                            direction=direction, slices=new_slices):
+            return self._resize_inner(namespace, name, new_slices,
+                                      direction, reason_label, message)
+
+    def _resize_inner(self, namespace: str, name: str, new_slices: int,
+                      direction: str, reason_label: str,
+                      message: str) -> bool:
         if (self.cp_health is not None
                 and not self.cp_health.allow_disruption("resize")):
+            trace_mod.JOURNAL.record(
+                namespace, name, "disruption.deferred",
+                "controlplane-degraded",
+                f"elastic {direction} ({message}) deferred: the API "
+                "server is degraded (docs/robustness.md)")
             return False
         key = (namespace, name)
         if direction == "shrink" and self.ckpt is not None:
@@ -718,6 +744,9 @@ class SliceGangScheduler(GangScheduler):
         metrics.job_slices.set(new_slices, job_namespace=namespace,
                                job=name)
         log.info("resized gang %s/%s: %s", namespace, name, detail)
+        trace_mod.JOURNAL.record(
+            namespace, name, "resized", reason_label, detail,
+            direction=direction, slices=new_slices)
         if self.recorder is not None:
             try:
                 self.recorder.event(
@@ -811,6 +840,13 @@ class SliceGangScheduler(GangScheduler):
         return group.status.pending_since or group.metadata.creation_timestamp
 
     def _admit(self) -> None:
+        # Admission is a traced pass: nested under the sync span when a
+        # job sync drove it, a root trace of its own when capacity
+        # events (readmit pokes) did.
+        with trace_mod.span("gang.admit_pass"):
+            self._admit_pass()
+
+    def _admit_pass(self) -> None:
         """Walk groups by (priority desc, creation asc); admit while the
         whole slice request fits the remaining chip budget (global and
         per-queue quota), applying fairness per queue lane when a group
@@ -858,7 +894,8 @@ class SliceGangScheduler(GangScheduler):
             qpass = None
             if self.quota is not None:
                 try:
-                    qpass = self.quota.plan(groups, _chips_for, now)
+                    with trace_mod.span("quota.plan"):
+                        qpass = self.quota.plan(groups, _chips_for, now)
                 except Exception:
                     log.exception("tenant-queue quota plan failed; "
                                   "running this pass without quota")
@@ -970,6 +1007,10 @@ class SliceGangScheduler(GangScheduler):
                             "slice group %s needs %d chips but the %s; "
                             "skipping (infeasible)",
                             group.metadata.name, need, why)
+                    trace_mod.JOURNAL.record(
+                        key[0], key[1], "admission.deny", "infeasible",
+                        f"needs {need} chips but the {why}; can never "
+                        "be admitted at any occupancy")
                     continue
                 if q in blocked:
                     floor = blocked[q]
@@ -984,6 +1025,11 @@ class SliceGangScheduler(GangScheduler):
                     if not passes_quota_lane and (floor is None
                                                   or pri < floor):
                         any_blocked = True
+                        trace_mod.JOURNAL.record(
+                            key[0], key[1], "admission.defer",
+                            "queue-blocked",
+                            f"queue {q!r} is held for an earlier group "
+                            "(head-of-line fairness); waiting behind it")
                         continue  # lane held for an earlier group
                 fits_phys = ((self._cap is None
                               or used + reserved + need <= self._cap)
@@ -1027,6 +1073,19 @@ class SliceGangScheduler(GangScheduler):
                         lane_quota_only[q] = False
                         continue
                 if not fits:
+                    if not fits_phys and (qpass is None or q_ok):
+                        # Physical-capacity block (quota blocks record
+                        # their own defer inside on_blocked below).
+                        if self._cap is not None:
+                            block_msg = (f"needs {need} chips; "
+                                         f"{used + reserved}/{self._cap} "
+                                         "in use or reserved")
+                        else:
+                            block_msg = (f"needs {need} chips over "
+                                         f"queue {q!r} quota {quota}")
+                        trace_mod.JOURNAL.record(
+                            key[0], key[1], "admission.defer",
+                            "capacity", block_msg)
                     if qpass is not None:
                         qpass.on_blocked(group, need, q_ok, q_why,
                                          q_terminal, fits_phys, pri)
@@ -1076,6 +1135,10 @@ class SliceGangScheduler(GangScheduler):
                     qpass.on_admit(group, need, q_borrow)
                 log.info("admitted slice group %s (%d chips, queue=%r, "
                          "priority=%d)", group.metadata.name, need, q, pri)
+                trace_mod.JOURNAL.record(
+                    key[0], key[1], "admission.admit", "admitted",
+                    f"gang admitted: {need} chips (queue={q!r}, "
+                    f"priority={pri}, borrowed={q_borrow})")
             self._warned_infeasible &= live_keys
             # Quota reclaim plan + per-queue status/metrics publication.
             reclaims: List[tuple] = []
@@ -1239,6 +1302,12 @@ class SliceGangScheduler(GangScheduler):
             log.info("preempted slice group %s (priority %d) for %s "
                      "(priority %d)", v.metadata.name,
                      self._priority_of(v), group.metadata.name, pri)
+            trace_mod.JOURNAL.record(
+                v.metadata.namespace, v.metadata.name, "preempted",
+                "priority-preemption",
+                f"evicted back to Pending (priority "
+                f"{self._priority_of(v)}) so {group.metadata.name} "
+                f"(priority {pri}) fits")
             vk = (v.metadata.namespace, v.metadata.name)
             # Either way the victim is out of this pass's admission walk
             # (it sorts after the higher-priority preemptor and must not

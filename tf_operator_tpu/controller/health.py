@@ -74,6 +74,7 @@ from tf_operator_tpu.api.types import (
 )
 from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime import trace as trace_mod
 from tf_operator_tpu.runtime.events import (
     EVENT_TYPE_NORMAL,
     EVENT_TYPE_WARNING,
@@ -332,6 +333,11 @@ class SliceHealthController:
                 # signal persists, so the next healthy pass drains.
                 # Gated BEFORE ready_to_evict so no barrier is opened
                 # that the controller may not be able to enforce.
+                trace_mod.JOURNAL.record(
+                    ns, name, "disruption.deferred",
+                    "controlplane-degraded",
+                    f"health drain ({', '.join(reasons)}) deferred: "
+                    "the API server is degraded (docs/robustness.md)")
                 continue
             if self._try_elastic_shrink(ns, name, job, bad_pods, reasons):
                 # The gang rides out the capacity loss as a shrink
@@ -433,6 +439,11 @@ class SliceHealthController:
         forever), then displace the SliceGroup back through admission.
         A failed eviction aborts the pass; the next one re-derives and
         retries with nothing double-counted."""
+        with trace_mod.span("health.drain", job=f"{ns}/{name}"):
+            self._drain_inner(ns, name, job, bad_pods, reasons)
+
+    def _drain_inner(self, ns: str, name: str, job: TPUJob,
+                     bad_pods: List[Pod], reasons: List[str]) -> None:
         group_pods = [
             p for p in self.store.list(
                 store_mod.PODS, namespace=ns,
@@ -462,6 +473,11 @@ class SliceHealthController:
                             name, e)
                 return
         reason_str = ", ".join(reasons)
+        trace_mod.JOURNAL.record(
+            ns, name, "drained", "node-degraded",
+            f"gang atomically drained off degraded node(s) "
+            f"({reason_str}); {len(group_pods)} pod(s) evicted, "
+            "re-entering admission for rebind on spare capacity")
         if self.gang is not None:
             self.gang.displace(ns, name,
                                f"node degraded ({reason_str})")

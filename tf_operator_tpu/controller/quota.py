@@ -54,6 +54,7 @@ from tf_operator_tpu.api.validation import (
 )
 from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime import trace as trace_mod
 from tf_operator_tpu.runtime.events import (
     EVENT_TYPE_NORMAL,
     EVENT_TYPE_WARNING,
@@ -327,13 +328,16 @@ class _QuotaPass:
             # by a full cohort, borrowers in its cohort are sitting on
             # its share: register the reclaim.
             self._reclaim_demands.append((priority, group, cq, need))
+            msg = (f"waiting for cohort {cq.spec.cohort!r} to "
+                   f"reclaim {need} chips of queue "
+                   f"{cq.metadata.name!r} nominal quota from "
+                   "borrowers")
             self.mgr._set_wait(group, QuotaWait(
-                queue=group.spec.queue,
-                message=(f"waiting for cohort {cq.spec.cohort!r} to "
-                         f"reclaim {need} chips of queue "
-                         f"{cq.metadata.name!r} nominal quota from "
-                         "borrowers"),
+                queue=group.spec.queue, message=msg,
                 since=group.status.pending_since or self.now))
+            trace_mod.JOURNAL.record(
+                group.metadata.namespace, group.metadata.name,
+                "admission.defer", "quota-reclaim-pending", msg)
             return
         if quota_ok:
             return  # over-nominal borrow that fits quota but not chips
@@ -342,6 +346,11 @@ class _QuotaPass:
             message=why or "waiting for quota",
             terminal=terminal,
             since=group.status.pending_since or self.now))
+        trace_mod.JOURNAL.record(
+            group.metadata.namespace, group.metadata.name,
+            "admission.deny" if terminal else "admission.defer",
+            "quota-terminal" if terminal else "quota",
+            why or "waiting for quota")
 
     # -- pass end -------------------------------------------------------
 

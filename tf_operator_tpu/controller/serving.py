@@ -33,6 +33,7 @@ from typing import Dict, Optional
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import ReplicaType, ServingPolicy, TPUJob
 from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime import trace as trace_mod
 from tf_operator_tpu.runtime.store import Store
 
 log = logging.getLogger("tpu_operator.serving")
@@ -67,7 +68,11 @@ class ServingManager:
             constants.ENV_SERVE_MAX_TOKENS: str(
                 policy.max_tokens_per_request),
         }
-        weights = self.tenant_weights(job.metadata.namespace)
+        # The weight derivation scans TenantQueues + their backing
+        # ClusterQueues per serving-pod create — attributable store
+        # cost inside the sync, so it gets its own child span.
+        with trace_mod.span("serving.tenant_weights"):
+            weights = self.tenant_weights(job.metadata.namespace)
         if weights:
             env[constants.ENV_SERVE_TENANT_WEIGHTS] = ",".join(
                 f"{name}={weight}"

@@ -42,6 +42,7 @@ from tf_operator_tpu.controller.expectations import (
 )
 from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime import trace as trace_mod
 from tf_operator_tpu.runtime.events import (
     EVENT_TYPE_NORMAL,
     EVENT_TYPE_WARNING,
@@ -226,7 +227,20 @@ class TPUJobController(JobPlugin):
             for rt in list(job.spec.replica_specs):
                 self._hash_cache.pop((job.metadata.uid, rt.lower()), None)
             self._garbage_collect(job)
+            self._prune_job_observability(job)
         self.enqueue(job.key())
+
+    @staticmethod
+    def _prune_job_observability(job: TPUJob) -> None:
+        """Job GC for job-LABELED observability state: the per-job
+        gauge series (goodput, slice count) would otherwise accumulate
+        one dead series per deleted job forever — unbounded exposition
+        cardinality on a long-running operator — and the decision
+        journal would keep answering for a job that no longer exists."""
+        ns, name = job.metadata.namespace, job.metadata.name
+        metrics.job_goodput_ratio.remove(job_namespace=ns, job=name)
+        metrics.job_slices.remove(job_namespace=ns, job=name)
+        trace_mod.JOURNAL.prune(ns, name)
 
     def _garbage_collect(self, job: TPUJob) -> None:
         """Cascade-delete owned objects. The reference gets this for free
@@ -354,8 +368,13 @@ class TPUJobController(JobPlugin):
 
     def sync_tpujob(self, key: str) -> None:
         """Reference syncTFJob (controller.go:300-343)."""
+        with trace_mod.span("sync", job=key):
+            self._sync_tpujob(key)
+
+    def _sync_tpujob(self, key: str) -> None:
         namespace, name = key.split("/", 1)
-        job = self.store.try_get(store_mod.TPUJOBS, namespace, name)
+        with trace_mod.span("job.fetch"):
+            job = self.store.try_get(store_mod.TPUJOBS, namespace, name)
         if job is None:
             log.info("job %s vanished; clearing expectations", key)
             self.expectations.delete_for_job(key)
@@ -370,16 +389,20 @@ class TPUJobController(JobPlugin):
                 self.engine.gang.delete_slice_group(ref)
             return
 
-        set_defaults(job)
-        try:
-            validate_job(job)
-        except ValidationError as e:
+        with trace_mod.span("spec.validate"):
+            set_defaults(job)
+            err = None
+            try:
+                validate_job(job)
+            except ValidationError as e:
+                err = e
+        if err is not None:
             # Invalid spec -> Failed status, no requeue (reference
             # job.go:87-135 writes Failed via the CRD REST client). Write
             # only on change: an unconditional write fires MODIFIED ->
             # re-enqueue -> write, a hot loop.
             old_status = job.status.deepcopy()
-            msg = f"TPUJob {key} is not valid: {e}"
+            msg = f"TPUJob {key} is not valid: {err}"
             if not cond.is_failed(job.status):
                 metrics.jobs_failed.inc(job_namespace=namespace)
             cond.update_job_conditions(job.status, JobConditionType.FAILED,
@@ -525,6 +548,10 @@ class TPUJobController(JobPlugin):
     def update_job_status_in_api(self, job: TPUJob) -> None:
         from tf_operator_tpu.runtime import retry as retry_mod
 
+        with trace_mod.span("status.write"):
+            self._update_job_status_in_api(job, retry_mod)
+
+    def _update_job_status_in_api(self, job: TPUJob, retry_mod) -> None:
         try:
             # Transient blips retry in place (the status write is the
             # one mutation EVERY sync performs — losing it to a 500

@@ -53,7 +53,7 @@ import random
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod

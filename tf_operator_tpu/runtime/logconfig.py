@@ -13,10 +13,15 @@ import json
 import logging
 from typing import Optional
 
+from tf_operator_tpu.runtime import trace as trace_mod
+
 
 class JSONFormatter(logging.Formatter):
     """One JSON object per line: time/level/msg/filename plus any
-    contextual fields attached via LoggerAdapter extras."""
+    contextual fields attached via LoggerAdapter extras. Lines emitted
+    inside a traced sync additionally carry ``trace_id``/``span`` from
+    the ambient trace context (runtime/trace.py), so logs and
+    ``/debug/traces`` cross-reference (docs/observability.md)."""
 
     _SKIP = frozenset(
         logging.makeLogRecord({}).__dict__) | {"message", "asctime"}
@@ -30,6 +35,10 @@ class JSONFormatter(logging.Formatter):
             "filename": f"{record.filename}:{record.lineno}",
             "logger": record.name,
         }
+        trace_id, span = trace_mod.current_ids()
+        if trace_id:
+            out["trace_id"] = trace_id
+            out["span"] = span
         for k, v in record.__dict__.items():
             if k not in self._SKIP and not k.startswith("_"):
                 out[k] = v

@@ -42,6 +42,19 @@ class _Metric:
         with self._lock:
             return sorted(self._children.items())
 
+    def remove(self, **labels: str) -> None:
+        """Drop one labeled child series so deleted objects stop
+        occupying the exposition forever (job-labeled gauges are pruned
+        by job GC — unbounded cardinality is a slow OOM on a
+        long-running operator). No-op when the series never existed."""
+        key = self._key(labels)
+        with self._lock:
+            self._children.pop(key, None)
+            self._drop_child(key)
+
+    def _drop_child(self, key: Tuple[str, ...]) -> None:
+        """Subclass hook: drop per-child state beyond ``_children``."""
+
     def _render_labels(self, values: Tuple[str, ...]) -> str:
         if not self.label_names:
             return ""
@@ -122,6 +135,11 @@ class Histogram(_Metric):
 
     def time(self, **labels: str) -> "_Timer":
         return _Timer(self, labels)
+
+    def _drop_child(self, key: Tuple[str, ...]) -> None:
+        self._counts.pop(key, None)
+        self._sums.pop(key, None)
+        self._totals.pop(key, None)
 
     def sum_value(self, **labels: str) -> float:
         """The series' cumulative _sum sample (benchmark artifacts read
@@ -450,6 +468,11 @@ chaos_faults_injected = REGISTRY.counter(
     "tpu_operator_chaos_faults_injected_total",
     "Faults the chaos layer injected (runtime/chaos.py FaultProfile; "
     "test/bench harnesses only — always 0 in production)", ["fault"])
+trace_spans_dropped = REGISTRY.counter(
+    "tpu_operator_trace_spans_dropped_total",
+    "Spans of completed traces the flight recorder did NOT retain "
+    "(neither slowest-N, errored, nor the sample ring — "
+    "docs/observability.md); phase totals still count them")
 
 # --- serving plane (tf_operator_tpu/serve; docs/serving.md SLO catalog).
 # Observed by the ServingEngine in whichever process runs it: each

@@ -1,9 +1,12 @@
-"""Monitoring HTTP endpoint: /metrics + /healthz (+ /debug/vars).
+"""Monitoring HTTP endpoint: /metrics + /healthz (+ /debug/*).
 
 Reference parity: startMonitoring (cmd/tf-operator.v1/main.go:39-50)
 serves promhttp + net/http/pprof on -monitoring-port (default 8443).
 Python profiling is served as a plain-text thread dump at /debug/stacks
-instead of pprof.
+instead of pprof. The flight recorder (runtime/trace.py) adds two JSON
+surfaces: /debug/traces (retained reconcile traces + phase totals) and
+/debug/jobs/<ns>/<name> (the per-job decision journal —
+docs/observability.md).
 """
 
 from __future__ import annotations
@@ -16,6 +19,7 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from tf_operator_tpu.runtime import trace as trace_mod
 from tf_operator_tpu.runtime.metrics import REGISTRY, Registry
 from tf_operator_tpu.version import version_string
 
@@ -36,6 +40,8 @@ def _thread_dump() -> str:
 
 class _Handler(BaseHTTPRequestHandler):
     registry: Registry = REGISTRY
+    recorder: trace_mod.FlightRecorder = trace_mod.RECORDER
+    journal: trace_mod.DecisionJournal = trace_mod.JOURNAL
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib API)
         path = self.path.split("?", 1)[0]
@@ -51,12 +57,41 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/debug/stacks":
             body = _thread_dump().encode()
             ctype = "text/plain"
+        elif path == "/debug/traces":
+            # Served whether or not tracing is on: off = empty recorder
+            # (plus whatever was retained before it was turned off).
+            payload = {"enabled": trace_mod.enabled(),
+                       **self.recorder.snapshot()}
+            body = (json.dumps(payload) + "\n").encode()
+            ctype = "application/json"
+        elif path.startswith("/debug/jobs/"):
+            parts = path[len("/debug/jobs/"):].split("/")
+            decisions = (self.journal.decisions(parts[0], parts[1])
+                         if len(parts) == 2 and all(parts) else None)
+            if decisions is None:
+                self._send_json(404, {
+                    "error": "no decision journal for this job (unknown "
+                             "job, or no control-plane decision has "
+                             "touched it yet)",
+                    "path": path})
+                return
+            self._send_json(200, {"namespace": parts[0], "name": parts[1],
+                                  "decisions": decisions})
+            return
         else:
             self.send_response(404)
             self.end_headers()
             return
         self.send_response(200)
         self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, status: int, payload: dict) -> None:
+        body = (json.dumps(payload) + "\n").encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -69,9 +104,13 @@ class MonitoringServer:
     """Serves the registry on a background thread; port 0 = ephemeral."""
 
     def __init__(self, port: int = 8443, host: str = "127.0.0.1",
-                 registry: Optional[Registry] = None):
+                 registry: Optional[Registry] = None,
+                 recorder: Optional[trace_mod.FlightRecorder] = None,
+                 journal: Optional[trace_mod.DecisionJournal] = None):
         handler = type("Handler", (_Handler,),
-                       {"registry": registry or REGISTRY})
+                       {"registry": registry or REGISTRY,
+                        "recorder": recorder or trace_mod.RECORDER,
+                        "journal": journal or trace_mod.JOURNAL})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
 
@@ -83,7 +122,8 @@ class MonitoringServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="monitoring", daemon=True)
         self._thread.start()
-        log.info("monitoring endpoint on :%d (/metrics /healthz)", self.port)
+        log.info("monitoring endpoint on :%d (/metrics /healthz "
+                 "/debug/traces /debug/jobs/<ns>/<name>)", self.port)
 
     def stop(self) -> None:
         self._httpd.shutdown()

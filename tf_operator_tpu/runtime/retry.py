@@ -53,6 +53,7 @@ from typing import Callable, Optional
 
 from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime import trace as trace_mod
 
 log = logging.getLogger("tpu_operator.retry")
 
@@ -120,35 +121,44 @@ def with_retries(fn: Callable[[], object], *,
     """Call ``fn``; on a retryable failure back off and try again until
     attempts or the deadline run out, then re-raise the last error.
     Success/failure outcomes feed ``health`` (degraded-mode tracking)
-    and retries are counted per ``component``."""
-    deadline = (time.monotonic() + policy.deadline_seconds
-                if policy.deadline_seconds is not None else None)
-    last: Optional[BaseException] = None
-    for attempt in range(policy.max_attempts):
-        try:
-            result = fn()
-        except BaseException as e:  # classified below; re-raised verbatim
-            if not retryable(e):
-                raise
-            last = e
+    and retries are counted per ``component``. Inside a traced sync
+    the whole call is a child span carrying its attempt count, and
+    backoff sleeps are attributed to the ``api_retry`` phase — retry
+    and conflict loops show up in the timeline instead of vanishing
+    into ``api_retries_total`` (runtime/trace.py)."""
+    with trace_mod.span(f"retry.{component or 'unknown'}") as sp:
+        deadline = (time.monotonic() + policy.deadline_seconds
+                    if policy.deadline_seconds is not None else None)
+        last: Optional[BaseException] = None
+        for attempt in range(policy.max_attempts):
+            try:
+                result = fn()
+            except BaseException as e:  # classified below; re-raised verbatim
+                if not retryable(e):
+                    sp.set(attempts=attempt + 1)
+                    raise
+                last = e
+                if health is not None:
+                    health.record_failure()
+                if attempt + 1 >= policy.max_attempts:
+                    break
+                delay = policy.delay(attempt, rng)
+                if deadline is not None and time.monotonic() + delay > deadline:
+                    break
+                metrics.api_retries.inc(component=component or "unknown")
+                log.debug("%s: transient failure (attempt %d/%d), retrying "
+                          "in %.3fs: %s", component or fn, attempt + 1,
+                          policy.max_attempts, delay, e)
+                trace_mod.note_phase("api_retry", delay)
+                sleep(delay)
+                continue
             if health is not None:
-                health.record_failure()
-            if attempt + 1 >= policy.max_attempts:
-                break
-            delay = policy.delay(attempt, rng)
-            if deadline is not None and time.monotonic() + delay > deadline:
-                break
-            metrics.api_retries.inc(component=component or "unknown")
-            log.debug("%s: transient failure (attempt %d/%d), retrying "
-                      "in %.3fs: %s", component or fn, attempt + 1,
-                      policy.max_attempts, delay, e)
-            sleep(delay)
-            continue
-        if health is not None:
-            health.record_success()
-        return result
-    assert last is not None
-    raise last
+                health.record_success()
+            sp.set(attempts=attempt + 1)
+            return result
+        assert last is not None
+        sp.set(attempts=policy.max_attempts, exhausted=True)
+        raise last
 
 
 def update_with_conflict_retry(store, kind: str, namespace: str,
@@ -164,23 +174,26 @@ def update_with_conflict_retry(store, kind: str, namespace: str,
     instead of silently losing to a racing writer. Returns the written
     object, or None when the object vanished / ``mutate`` aborted /
     attempts ran out."""
-    for attempt in range(attempts):
-        obj = store.try_get(kind, namespace, name)
-        if obj is None:
-            return None
-        if mutate(obj) is False:
-            return None
-        try:
-            if status:
-                return store.update_status(kind, obj)
-            return store.update(kind, obj)
-        except store_mod.ConflictError:
-            if attempt + 1 < attempts:
-                metrics.api_retries.inc(component=component or "conflict")
-            continue
-        except store_mod.NotFoundError:
-            return None
-    return None
+    with trace_mod.span(f"retry.{component or 'conflict'}") as sp:
+        for attempt in range(attempts):
+            obj = store.try_get(kind, namespace, name)
+            if obj is None:
+                return None
+            if mutate(obj) is False:
+                return None
+            try:
+                sp.set(attempts=attempt + 1)
+                if status:
+                    return store.update_status(kind, obj)
+                return store.update(kind, obj)
+            except store_mod.ConflictError:
+                if attempt + 1 < attempts:
+                    metrics.api_retries.inc(component=component or "conflict")
+                continue
+            except store_mod.NotFoundError:
+                return None
+        sp.set(exhausted=True)
+        return None
 
 
 class ControlPlaneHealth:

@@ -43,6 +43,7 @@ from collections import deque
 from typing import Dict, Hashable, List, Optional, Tuple
 
 from tf_operator_tpu.runtime import metrics
+from tf_operator_tpu.runtime import trace as trace_mod
 
 
 class ShutDown(Exception):
@@ -122,8 +123,12 @@ class RateLimitingQueue:
             self._dirty.discard(item)
             added = self._added_at.pop(item, None)
             if added is not None and self._instrument:
-                metrics.workqueue_latency_seconds.observe(
-                    time.monotonic() - added)
+                wait = time.monotonic() - added
+                metrics.workqueue_latency_seconds.observe(wait)
+                # Flight-recorder phase attribution: enqueue->dequeue
+                # wait is the "queue_wait" phase of the item's next
+                # sync (no span — the wait belongs to no trace yet).
+                trace_mod.note_phase("queue_wait", wait)
             self._set_depth()
             return item
 

@@ -370,3 +370,60 @@ class TPUJobClient:
         get_creation_failures_from_tfjob, tf_job_client.py:363)."""
         return [e.message for e in self.get_events(name, namespace=namespace)
                 if e.reason.startswith("FailedCreate")]
+
+    # -- explain (flight-recorder decision journal) ---------------------
+
+    def explain(self, name: str,
+                namespace: Optional[str] = None) -> Dict:
+        """Why is my job in this state — answered from the operator,
+        not from log archaeology (docs/observability.md): the job's
+        conditions, its decision-journal records (every admission
+        defer/deny, barrier open/resolve, displacement, and resize the
+        control plane decided, with reasons and trace ids), and its
+        recent lifecycle events.
+
+        The journal is read in-process (runtime/trace.py JOURNAL) —
+        against a remote store this surface carries conditions/events
+        only; the journal of a remote operator is served by ITS
+        monitoring endpoint at ``/debug/jobs/<ns>/<name>``."""
+        ns = namespace or self.namespace
+        job = self.get(name, ns)
+        from tf_operator_tpu.runtime import trace as trace_lib
+
+        decisions = trace_lib.JOURNAL.decisions(ns, name) or []
+        return {
+            "namespace": ns,
+            "name": name,
+            "phase": (job.status.conditions[-1].type
+                      if job.status.conditions else ""),
+            "conditions": [
+                {"type": c.type, "status": c.status, "reason": c.reason,
+                 "message": c.message}
+                for c in job.status.conditions],
+            "decisions": decisions,
+            "events": [
+                {"type": e.type, "reason": e.reason, "message": e.message}
+                for e in self.get_events(name, namespace=ns)[-20:]],
+        }
+
+    def explain_text(self, name: str,
+                     namespace: Optional[str] = None) -> str:
+        """``tpujob explain``-style rendering of :meth:`explain` (the
+        CLI surface: ``python -c`` one-liners and notebooks print it)."""
+        info = self.explain(name, namespace=namespace)
+        lines = [f"TPUJob {info['namespace']}/{info['name']}: "
+                 f"{info['phase'] or 'no conditions yet'}"]
+        for c in info["conditions"]:
+            lines.append(f"  condition {c['type']}={c['status']} "
+                         f"({c['reason']}): {c['message']}")
+        if info["decisions"]:
+            lines.append("  decision journal (oldest first):")
+            for d in info["decisions"]:
+                count = f" x{d['count']}" if d.get("count", 1) > 1 else ""
+                tid = f" [{d['trace_id']}]" if d.get("trace_id") else ""
+                lines.append(f"    {d['kind']}/{d['reason']}{count}"
+                             f"{tid}: {d['message']}")
+        else:
+            lines.append("  decision journal: no control-plane decision "
+                         "has touched this job")
+        return "\n".join(lines)
