@@ -86,6 +86,21 @@ RESOURCE_TPU = "google.com/tpu"
 # loop forces a final save and acks through its CheckpointRecord.
 ANNOTATION_PREEMPT_NOTICE = "tpu-operator.dev/preemption-notice"
 
+# Node-agent relay (runtime/nodeagent.py, the DaemonSet plane for
+# --backend kube). The controller stamps a per-incarnation relay token
+# on pods it creates when a relay directory is configured: the agent and
+# the rendered TPUJOB_*_FILE env derive file paths from the token, so a
+# recreated pod (same name, new incarnation) never reads the dead
+# incarnation's notice. The agent mirrors the worker's checkpoint file
+# back by PATCHing its JSON onto the ckpt-state annotation, which the
+# operator converts into the pod's CheckpointRecord; the heartbeat
+# annotation on the Node is how the operator decides a node is
+# barrier-capable (a stale/absent agent degrades drains to plain
+# eviction instead of hanging on a barrier nobody will relay).
+ANNOTATION_RELAY_TOKEN = "tpu-operator.dev/relay-token"
+ANNOTATION_CKPT_STATE = "tpu-operator.dev/ckpt-state"
+ANNOTATION_AGENT_HEARTBEAT = "tpu-operator.dev/agent-heartbeat"
+
 # Env the data plane gives every pod it spawns: where the preemption
 # notice will appear, and where the worker publishes its checkpoint
 # state (saves / barrier acks / restore confirmation) for the plane to

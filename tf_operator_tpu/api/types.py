@@ -239,6 +239,13 @@ class PodSpec(ApiObject):
     # pending pods by CAS-ing their own name in (pull scheduling — the
     # kube-scheduler binding analog for the served control plane).
     node_name: str = ""
+    # Host directory the node-agent relay shares with this pod's
+    # containers (docs/node-agent.md). When set, the kube renderer
+    # mounts it as a hostPath volume at the same path in every
+    # container, and TPUJOB_PREEMPT_FILE / TPUJOB_CKPT_FILE env point
+    # into it (relay-token-keyed; runtime/relay.py). Empty = no relay
+    # (the local backend injects its own file paths at spawn time).
+    relay_dir: str = ""
 
     def container(self, name: str) -> Optional[Container]:
         for c in self.containers:
@@ -759,6 +766,17 @@ class CheckpointRecord(ApiObject):
 # ---------------------------------------------------------------------------
 
 @dataclasses.dataclass
+class Taint(ApiObject):
+    """core/v1 Taint subset the binder filters on. A NoSchedule or
+    NoExecute taint excludes the node for pods that don't carry a
+    matching Toleration (PreferNoSchedule stays advisory)."""
+
+    key: str = ""
+    value: str = ""
+    effect: str = ""               # NoSchedule|PreferNoSchedule|NoExecute
+
+
+@dataclasses.dataclass
 class NodeSpec(ApiObject):
     # Address peers dial to reach pods on this node (TPU worker host IP).
     address: str = "127.0.0.1"
@@ -768,6 +786,10 @@ class NodeSpec(ApiObject):
     # Cordoned (core/v1 Node.spec.unschedulable): the gang binder skips
     # the node and its chips leave the admission capacity.
     unschedulable: bool = False
+    # core/v1 Node.spec.taints — hard placement exclusions the binder
+    # honors (a bind violating them would be rejected or the pod evicted
+    # by kubelet/the taint manager anyway).
+    taints: List[Taint] = field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -783,6 +805,11 @@ class NodeStatus(ApiObject):
     # TerminationScheduled — TPU maintenance events / spot preemption
     # notices surfaced as conditions, node-problem-detector style).
     conditions: Dict[str, str] = field(default_factory=dict)
+    # Allocatable cpu/memory (core/v1 Node.status.allocatable, parsed
+    # from quantity strings). None = unreported — the binder skips the
+    # fit check rather than rejecting every node on a sparse inventory.
+    allocatable_cpu_millis: Optional[int] = None
+    allocatable_memory_bytes: Optional[int] = None
 
 
 @dataclasses.dataclass

@@ -159,6 +159,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "tenantQueues to seed at startup (see "
                         "docs/quota.md for the format); queues can also "
                         "be created live through the served API")
+    p.add_argument("--agent-relay-dir",
+                   default="/var/run/tpu-operator/relay",
+                   help="(kube backend) hostPath directory shared "
+                        "between workload pods and the node-agent "
+                        "DaemonSet (docs/node-agent.md): checkpoint-"
+                        "coordinated and serving pods get it mounted "
+                        "and their TPUJOB_PREEMPT_FILE/TPUJOB_CKPT_FILE "
+                        "paths rendered inside it; must match the "
+                        "agents' --relay-dir. Empty disables relay "
+                        "rendering (barriers degrade to plain eviction)")
     p.add_argument("--gang-binder", default=True,
                    action=argparse.BooleanOptionalAction,
                    help="(kube backend) run the in-operator slice-gang "
@@ -333,6 +343,11 @@ class Server:
                 raise RuntimeError(
                     f"CRD not installed on {client.config.server}; apply "
                     "manifests/base/crd.yaml first")
+            # Everything in tenant_kwargs except enable_elastic is
+            # lifted onto kube by the node-agent relay
+            # (docs/node-agent.md); elastic stays gated in main().
+            kube_tenant_kwargs = {k: v for k, v in tenant_kwargs.items()
+                                  if k != "enable_elastic"}
             self.operator = KubeOperator(
                 client,
                 namespace=args.namespace or None,
@@ -342,7 +357,8 @@ class Server:
                     args, "health_drain_grace_seconds", 0.0),
                 degraded_after_seconds=getattr(
                     args, "degraded_after_seconds", 10.0),
-                **gang_kwargs)
+                relay_dir=getattr(args, "agent_relay_dir", ""),
+                **gang_kwargs, **kube_tenant_kwargs)
             self.store = self.operator.store
             self._lease_store = KubeLeaseStore(client)
         else:
@@ -509,11 +525,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                      "--enable-gang-scheduling: tenant queues decide "
                      "WHICH gangs are quota-eligible; without gang "
                      "admission there is nothing to gate")
-    if args.enable_tenant_queues and args.backend == "kube":
-        parser.error("--enable-tenant-queues is not yet supported with "
-                     "--backend kube (the TenantQueue/ClusterQueue kinds "
-                     "have no CRD/informer mirror yet); use the local or "
-                     "served backend")
     if args.queue_config and not args.enable_tenant_queues:
         parser.error("--queue-config only makes sense with "
                      "--enable-tenant-queues")
@@ -524,22 +535,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                      "there is no slice accounting to resize against")
     if args.enable_elastic and args.backend == "kube":
         parser.error("--enable-elastic is not yet supported with "
-                     "--backend kube: a shrink's save-before-evict "
-                     "barrier needs the preemption-notice/ack relay "
-                     "that only the per-node agent can provide there "
-                     "(ROADMAP.md item 1, node agent); use the local "
-                     "or served backend")
-    if args.enable_serving and args.backend == "kube":
-        parser.error("--enable-serving is not yet supported with "
-                     "--backend kube (the serving worker's spool and "
-                     "notice-relay files need the node agent recorded "
-                     "in ROADMAP.md); use the local or served backend")
-    if args.enable_ckpt_coordination and args.backend == "kube":
-        parser.error("--enable-ckpt-coordination is not yet supported "
-                     "with --backend kube (kubelet cannot relay the "
-                     "preemption-notice/ack files; needs the sidecar "
-                     "relay recorded in ROADMAP.md); use the local or "
-                     "served backend")
+                     "--backend kube: a world-resize restart rewrites "
+                     "pod env in place, which the node agent relay "
+                     "does not propagate to running containers yet "
+                     "(docs/elastic.md Scope); use the local or served "
+                     "backend")
     if args.backend == "kube" and args.api_port != 0:
         parser.error("--backend kube cannot serve --api-port: the Store "
                      "is a read cache of the cluster there, so jobs "

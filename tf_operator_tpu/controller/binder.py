@@ -40,7 +40,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from tf_operator_tpu.api import constants
-from tf_operator_tpu.api.types import Node, Pod, SliceGroup
+from tf_operator_tpu.api.types import Node, ObjectMeta, Pod, SliceGroup
 from tf_operator_tpu.bootstrap.topology import parse_accelerator
 from tf_operator_tpu.controller.health import (
     job_health_policy,
@@ -100,11 +100,130 @@ def node_ici_domain(node: Node) -> str:
     return node.metadata.name
 
 
+# -- hard placement predicates ------------------------------------------
+#
+# kube-scheduler filters before it scores; a direct pods/binding POST
+# bypasses every filter, so the binder must apply the ones kubelet (or
+# the taint manager) would otherwise enforce by rejecting/evicting what
+# we placed: taints vs tolerations, nodeSelector, and cpu/mem fit.
+# These are FILTERS, not preferences — a node that fails one is never a
+# candidate, no matter how many chips it has free. kube_fake's binding
+# subresource runs the same predicate so tier-1 pins the contract.
+
+def parse_cpu_quantity_millis(raw) -> Optional[int]:
+    """'500m' -> 500, '2' -> 2000. None = unparseable/absent."""
+    raw = str(raw or "").strip()
+    if not raw:
+        return None
+    try:
+        if raw.endswith("m"):
+            return int(float(raw[:-1]))
+        return int(float(raw) * 1000)
+    except ValueError:
+        return None
+
+
+_MEMORY_SUFFIXES = (
+    ("Ei", 1024 ** 6), ("Pi", 1024 ** 5), ("Ti", 1024 ** 4),
+    ("Gi", 1024 ** 3), ("Mi", 1024 ** 2), ("Ki", 1024),
+    ("E", 1000 ** 6), ("P", 1000 ** 5), ("T", 1000 ** 4),
+    ("G", 1000 ** 3), ("M", 1000 ** 2), ("k", 1000), ("K", 1000),
+)
+
+
+def parse_memory_quantity_bytes(raw) -> Optional[int]:
+    """'512Mi' -> bytes; bare numbers are bytes. None = unparseable."""
+    raw = str(raw or "").strip()
+    if not raw:
+        return None
+    for suffix, mult in _MEMORY_SUFFIXES:
+        if raw.endswith(suffix):
+            try:
+                return int(float(raw[:-len(suffix)]) * mult)
+            except ValueError:
+                return None
+    try:
+        return int(float(raw))
+    except ValueError:
+        return None
+
+
+def pod_cpu_millis(pod: Pod) -> int:
+    total = 0
+    for c in pod.spec.containers:
+        total += parse_cpu_quantity_millis(c.resources.get("cpu")) or 0
+    return total
+
+
+def pod_memory_bytes(pod: Pod) -> int:
+    total = 0
+    for c in pod.spec.containers:
+        total += parse_memory_quantity_bytes(
+            c.resources.get("memory")) or 0
+    return total
+
+
+def _toleration_matches(tol, taint) -> bool:
+    """core/v1 semantics: empty tol key + Exists tolerates everything;
+    empty tol effect tolerates all effects; Equal also matches value."""
+    if tol.key:
+        if tol.key != taint.key:
+            return False
+    elif tol.operator != "Exists":
+        return False
+    if tol.effect and tol.effect != taint.effect:
+        return False
+    if tol.operator == "Equal" and tol.value != taint.value:
+        return False
+    return True
+
+
+def node_rejects_pod(pod: Pod, node: Node,
+                     free_cpu_millis: Optional[int] = None,
+                     free_memory_bytes: Optional[int] = None
+                     ) -> Optional[str]:
+    """The reason kube would refuse this placement, or None when the
+    node is a legal candidate. ``free_*`` default to the node's full
+    allocatable; callers doing pass-local accounting hand in what's
+    left. None allocatable = unreported inventory — the fit check is
+    skipped rather than rejecting every node."""
+    for taint in node.spec.taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue  # PreferNoSchedule is advisory
+        if not any(_toleration_matches(t, taint)
+                   for t in pod.spec.tolerations):
+            return (f"node {node.metadata.name} has untolerated taint "
+                    f"{taint.key}:{taint.effect}")
+    if pod.spec.node_selector:
+        labels = dict(node.spec.labels)
+        labels.update(node.metadata.labels)
+        for k, v in pod.spec.node_selector.items():
+            if labels.get(k) != v:
+                return (f"node {node.metadata.name} does not match "
+                        f"nodeSelector {k}={v}")
+    if free_cpu_millis is None:
+        free_cpu_millis = node.status.allocatable_cpu_millis
+    if free_memory_bytes is None:
+        free_memory_bytes = node.status.allocatable_memory_bytes
+    need_cpu = pod_cpu_millis(pod)
+    if need_cpu and free_cpu_millis is not None \
+            and need_cpu > free_cpu_millis:
+        return (f"node {node.metadata.name} lacks cpu "
+                f"({need_cpu}m requested, {free_cpu_millis}m free)")
+    need_mem = pod_memory_bytes(pod)
+    if need_mem and free_memory_bytes is not None \
+            and need_mem > free_memory_bytes:
+        return (f"node {node.metadata.name} lacks memory "
+                f"({need_mem} bytes requested, {free_memory_bytes} free)")
+    return None
+
+
 class _NodeState:
-    __slots__ = ("name", "domain", "free", "pending")
+    __slots__ = ("name", "domain", "free", "pending", "node",
+                 "free_cpu", "free_mem")
 
     def __init__(self, name: str, domain: str, free: int,
-                 pending: bool = False):
+                 pending: bool = False, node: Optional[Node] = None):
         self.name = name
         self.domain = domain
         self.free = free
@@ -112,6 +231,14 @@ class _NodeState:
         # may not have cordoned it yet, or cordoning is disabled) but
         # announced to degrade — placement prefers clean capacity.
         self.pending = pending
+        # The Node object, for the hard placement predicates
+        # (taints/nodeSelector); a test double passing none gets a
+        # predicate-neutral blank node.
+        self.node = node if node is not None else Node(
+            metadata=ObjectMeta(name=name))
+        # Pass-local cpu/mem accounting (None = node didn't report).
+        self.free_cpu = self.node.status.allocatable_cpu_millis
+        self.free_mem = self.node.status.allocatable_memory_bytes
 
 
 class SliceGangBinder:
@@ -225,7 +352,8 @@ class SliceGangBinder:
                 continue
             states[n.metadata.name] = _NodeState(
                 n.metadata.name, domain_of_any[n.metadata.name],
-                n.spec.chips, pending=node_maintenance_pending(n))
+                n.spec.chips, pending=node_maintenance_pending(n),
+                node=n)
 
         # Chip accounting is deliberately UNSCOPED: node capacity is
         # cluster-wide, so occupancy must be too. (A namespace-scoped
@@ -238,7 +366,12 @@ class SliceGangBinder:
             terminal = p.status.phase in ("Succeeded", "Failed")
             if p.spec.node_name:
                 if not terminal and p.spec.node_name in states:
-                    states[p.spec.node_name].free -= pod_chip_demand(p)
+                    st = states[p.spec.node_name]
+                    st.free -= pod_chip_demand(p)
+                    if st.free_cpu is not None:
+                        st.free_cpu -= pod_cpu_millis(p)
+                    if st.free_mem is not None:
+                        st.free_mem -= pod_memory_bytes(p)
                 continue
             if (self.namespace is not None
                     and p.metadata.namespace != self.namespace):
@@ -376,7 +509,7 @@ class SliceGangBinder:
                     # MODIFIED event hasn't mirrored yet — stay
                     # conservative within the pass rather than
                     # double-booking chips a 409 just proved contested.
-                    st.free -= pod_chip_demand(pod)
+                    self._consume(st, pod)
                 if outcome == "bound":
                     committed.append((pod, st))
                     bound += 1
@@ -394,10 +527,18 @@ class SliceGangBinder:
                 continue
             outcome = self._bind(pod, st)
             if outcome != "failed":
-                st.free -= pod_chip_demand(pod)
+                self._consume(st, pod)
             if outcome == "bound":
                 bound += 1
         return bound
+
+    @staticmethod
+    def _consume(st: _NodeState, pod: Pod) -> None:
+        st.free -= pod_chip_demand(pod)
+        if st.free_cpu is not None:
+            st.free_cpu -= pod_cpu_millis(pod)
+        if st.free_mem is not None:
+            st.free_mem -= pod_memory_bytes(pod)
 
     def _plan_slice(self, pods: List[Pod], states: Dict[str, _NodeState],
                     pinned_domain: Optional[str],
@@ -429,11 +570,21 @@ class SliceGangBinder:
             if not nodes:
                 continue
             free = {st.name: st.free for st in nodes}
+            free_cpu = {st.name: st.free_cpu for st in nodes}
+            free_mem = {st.name: st.free_mem for st in nodes}
             plan: List[Tuple[Pod, _NodeState]] = []
             ok = True
             for pod in demands:
                 need = pod_chip_demand(pod)
-                fitting = [st for st in nodes if free[st.name] >= need]
+                # Chips first (cheap), then the hard kube predicates:
+                # taints/nodeSelector/cpu-mem fit are filters — a node
+                # failing one is no candidate regardless of free chips.
+                fitting = [
+                    st for st in nodes
+                    if free[st.name] >= need
+                    and node_rejects_pod(pod, st.node,
+                                         free_cpu[st.name],
+                                         free_mem[st.name]) is None]
                 if not fitting:
                     ok = False
                     break
@@ -441,6 +592,10 @@ class SliceGangBinder:
                            key=lambda st: (prefer_clean and st.pending,
                                            free[st.name]))
                 free[best.name] -= need
+                if free_cpu[best.name] is not None:
+                    free_cpu[best.name] -= pod_cpu_millis(pod)
+                if free_mem[best.name] is not None:
+                    free_mem[best.name] -= pod_memory_bytes(pod)
                 plan.append((pod, best))
             if ok:
                 return plan
@@ -451,7 +606,10 @@ class SliceGangBinder:
                             prefer_clean: bool = True
                             ) -> Optional[_NodeState]:
         need = pod_chip_demand(pod)
-        fitting = [st for st in states.values() if st.free >= need]
+        fitting = [st for st in states.values()
+                   if st.free >= need
+                   and node_rejects_pod(pod, st.node, st.free_cpu,
+                                        st.free_mem) is None]
         if not fitting:
             return None
         # Most-free node, clean (no maintenance notice) first: keeps

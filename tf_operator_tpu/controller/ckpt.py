@@ -125,15 +125,31 @@ class CheckpointCoordinator:
     sleeping); ``on_ack`` (usually ``gang.readmit``) is poked when a
     record lands inside an active barrier so a completed barrier
     releases its eviction on the next admission pass instead of the next
-    resync."""
+    resync.
+
+    Two backend hooks keep the coordinator plane-agnostic:
+    ``annotate_pod(ns, name, annotations)`` routes notice stamps through
+    the backend's write path (on kube a merge PATCH to the API server —
+    writing the informer-mirrored store copy would be clobbered by the
+    next relist); ``barrier_capable(pods)`` says whether the gang's
+    nodes have a relay that will actually deliver notices (kube: fresh
+    node-agent heartbeats). When it returns False the gate degrades to
+    the pre-coordinator eviction path instead of opening a barrier
+    nobody can ack — a missing agent must not hang a drain. Both default
+    to None: the local plane stamps through the store and is always
+    relay-capable."""
 
     def __init__(self, store: Store, recorder=None,
                  namespace: Optional[str] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 annotate_pod=None,
+                 barrier_capable=None):
         self.store = store
         self.recorder = recorder
         self.namespace = namespace
         self.clock = clock
+        self.annotate_pod = annotate_pod
+        self.barrier_capable = barrier_capable
         self.on_ack = None
         self._lock = threading.RLock()
         # (ns, job) -> in-flight barrier.
@@ -207,6 +223,15 @@ class CheckpointCoordinator:
         policy = job_checkpoint_policy(job)
         if policy is None:
             return True  # pre-coordinator path, byte-identical
+        if self.barrier_capable is not None and not self.barrier_capable(
+                self._live_pods(namespace, name)):
+            # No relay will deliver the notice (kube node agent absent
+            # or stale on some gang node): degrade to plain eviction
+            # now rather than opening a barrier that can only time out.
+            log.info("gang %s/%s is not barrier-capable (no node-agent "
+                     "relay); evicting without a barrier", namespace,
+                     name)
+            return True
         key = (namespace, name)
         with self._lock:
             barrier = self._barriers.get(key)
@@ -298,6 +323,20 @@ class CheckpointCoordinator:
                 continue
             if pod.metadata.annotations.get(
                     constants.ANNOTATION_PREEMPT_NOTICE) == notice:
+                barrier.stamped.add(pod.metadata.name)
+                continue
+            if self.annotate_pod is not None:
+                # Backend write path (kube: merge PATCH — the mirrored
+                # store copy would be clobbered by the next relist).
+                try:
+                    self.annotate_pod(
+                        pod.metadata.namespace, pod.metadata.name,
+                        {constants.ANNOTATION_PREEMPT_NOTICE: notice})
+                except Exception:
+                    log.debug("stamping notice on %s/%s failed; next "
+                              "consult re-stamps", pod.metadata.namespace,
+                              pod.metadata.name, exc_info=True)
+                    continue
                 barrier.stamped.add(pod.metadata.name)
                 continue
 

@@ -128,7 +128,8 @@ class TPUJobController(JobPlugin):
                  namespace: Optional[str] = None,
                  ckpt=None,
                  cp_health=None,
-                 serving=None):
+                 serving=None,
+                 relay_dir: str = ""):
         self.store = store
         self.recorder = recorder or Recorder()
         self.namespace = namespace  # None = all namespaces
@@ -138,6 +139,13 @@ class TPUJobController(JobPlugin):
         # restore-with-identity env into created pods and rolls the
         # barrier arc into job status (via the engine hook).
         self.ckpt = ckpt
+        # Node-agent relay directory (--agent-relay-dir, kube backend):
+        # pods that participate in checkpoint/serving coordination get
+        # this hostPath mounted, a per-incarnation relay token, and
+        # TPUJOB_PREEMPT_FILE / TPUJOB_CKPT_FILE env pointing into it
+        # (runtime/relay.py path contract). Empty = no relay rendering
+        # (the local data plane injects its own paths at spawn time).
+        self.relay_dir = relay_dir
         # Optional serving manager (controller/serving.py): renders
         # ServingPolicy env into serving-role pods. None (the
         # --enable-serving default) leaves the serving role inert.
@@ -630,6 +638,41 @@ class TPUJobController(JobPlugin):
         # restart live serving replicas mid-traffic).
         if self.serving is not None:
             container.env.update(self.serving.bootstrap_env(job, rtype))
+        # Node-agent relay (runtime/relay.py): mount the shared relay
+        # volume and render the notice/checkpoint file paths for pods a
+        # coordination subsystem will actually talk to. Token-keyed, not
+        # uid-keyed — the path must render NOW, before the apiserver
+        # assigns a uid, and each incarnation gets a fresh token so a
+        # recreated pod never reads a dead incarnation's notice. Outside
+        # the bootstrap hash like the ckpt/serving env above.
+        if self.relay_dir and self._pod_uses_relay(job, rtype):
+            import uuid as _uuid
+
+            from tf_operator_tpu.runtime import relay as relay_mod
+
+            pod.metadata.annotations.setdefault(
+                constants.ANNOTATION_RELAY_TOKEN, _uuid.uuid4().hex[:8])
+            pod.spec.relay_dir = self.relay_dir
+            container.env[constants.ENV_PREEMPT_FILE] = \
+                relay_mod.preempt_path(self.relay_dir, pod)
+            container.env[constants.ENV_CKPT_FILE] = \
+                relay_mod.ckpt_path(self.relay_dir, pod)
+
+    def _pod_uses_relay(self, job: TPUJob, rtype: str) -> bool:
+        """Relay files only reach pods a coordination plane will talk
+        to: any replica of a checkpoint-policy job (the barrier notices
+        every stamped pod), serving replicas under --enable-serving
+        (drain re-spool rides the same files). Everything else keeps
+        today's pod shape byte-identical."""
+        if self.ckpt is not None:
+            from tf_operator_tpu.controller.ckpt import (
+                job_checkpoint_policy,
+            )
+
+            if job_checkpoint_policy(job) is not None:
+                return True
+        return (self.serving is not None
+                and rtype.lower() == ReplicaType.SERVING)
 
     def bootstrap_hash(self, job: TPUJob, rtype: str, index: int) -> str:
         """Cached world digest: the env render + sha1 is a pure function

@@ -47,6 +47,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 from tf_operator_tpu.api.types import Node, NodeSpec, NodeStatus, Pod
+from tf_operator_tpu.runtime import metrics
+from tf_operator_tpu.runtime import retry as retry_mod
 from tf_operator_tpu.runtime import store as store_mod
 from tf_operator_tpu.runtime.local import LocalProcessBackend, _free_port
 from tf_operator_tpu.runtime.remote import RemoteStore
@@ -308,27 +310,67 @@ class NodeAgent:
         self._log_httpd.server_close()
 
     def _register_node(self) -> None:
+        cpu, mem = _host_allocatable()
         node = Node(spec=NodeSpec(address=self.address, chips=self.chips),
                     status=NodeStatus(last_heartbeat=_now(),
-                                      log_url=self.log_url))
+                                      log_url=self.log_url,
+                                      allocatable_cpu_millis=cpu,
+                                      allocatable_memory_bytes=mem))
         node.metadata.name = self.name
         node.metadata.namespace = "default"
-        existing = self.store.try_get(store_mod.NODES, "default", self.name)
-        if existing is None:
-            self.store.create(store_mod.NODES, node)
-        else:
-            node.metadata.resource_version = existing.metadata.resource_version
-            self.store.update(store_mod.NODES, node)
+
+        def _register():
+            existing = self.store.try_get(store_mod.NODES, "default",
+                                          self.name)
+            if existing is None:
+                self.store.create(store_mod.NODES, node)
+            else:
+                node.metadata.resource_version = \
+                    existing.metadata.resource_version
+                self.store.update(store_mod.NODES, node)
+
+        # Registration must survive a control-plane blip at agent boot:
+        # without a Node record no pod ever lands here. Conflicts
+        # (another register racing our read) retry through the re-read.
+        retry_mod.with_retries(
+            _register, policy=retry_mod.CLIENT_POLICY,
+            component="agent.register",
+            retryable=lambda e: (retry_mod.is_transient(e)
+                                 or isinstance(e, (store_mod.ConflictError,
+                                                   store_mod.AlreadyExistsError))))
+
+    def _heartbeat_once(self) -> bool:
+        def _beat():
+            node = self.store.get(store_mod.NODES, "default", self.name)
+            node.status.last_heartbeat = _now()
+            node.status.log_url = self.log_url
+            self.store.update_status(store_mod.NODES, node)
+
+        try:
+            retry_mod.with_retries(
+                _beat, component="agent.heartbeat",
+                retryable=lambda e: (retry_mod.is_transient(e)
+                                     or isinstance(e,
+                                                   store_mod.ConflictError)))
+        except store_mod.NotFoundError:
+            # The control plane restarted and lost our Node (or an
+            # operator GC'd it): re-register instead of heartbeating
+            # into the void forever.
+            try:
+                self._register_node()
+            except Exception:
+                log.warning("node re-registration failed", exc_info=True)
+                return False
+        except Exception:
+            log.warning("heartbeat failed; node %s will look stale until "
+                        "one lands", self.name, exc_info=True)
+            return False
+        metrics.node_agent_heartbeats.inc(node=self.name)
+        return True
 
     def _heartbeat_loop(self) -> None:
         while not self._stopped.wait(HEARTBEAT_SECONDS):
-            try:
-                node = self.store.get(store_mod.NODES, "default", self.name)
-                node.status.last_heartbeat = _now()
-                node.status.log_url = self.log_url
-                self.store.update_status(store_mod.NODES, node)
-            except Exception:
-                log.debug("heartbeat failed", exc_info=True)
+            self._heartbeat_once()
 
     # -- claiming ----------------------------------------------------------
 
@@ -351,9 +393,20 @@ class NodeAgent:
         fresh.status.host = self.address
         fresh.status.ports = {COORDINATOR_PORT_NAME: _free_port()}
         try:
-            self.store.update(store_mod.PODS, fresh)
+            # Transient API blips retry in place (a claim lost to a 500
+            # is a pod nobody runs until the next watch event); Conflict
+            # and NotFound stay semantic — another agent won, or the pod
+            # vanished.
+            retry_mod.with_retries(
+                lambda: self.store.update(store_mod.PODS, fresh),
+                component="agent.claim")
         except (store_mod.ConflictError, store_mod.NotFoundError):
-            return  # another agent won, or the pod vanished
+            return
+        except Exception:
+            log.warning("claim of pod %s/%s failed after retries",
+                        pod.metadata.namespace, pod.metadata.name,
+                        exc_info=True)
+            return
         log.info("claimed pod %s/%s", pod.metadata.namespace,
                  pod.metadata.name)
 
@@ -379,6 +432,24 @@ class NodeAgent:
 
 def _now() -> _dt.datetime:
     return _dt.datetime.now(_dt.timezone.utc)
+
+
+def _host_allocatable() -> Tuple[Optional[int], Optional[int]]:
+    """Best-effort host inventory (cpu millis, memory bytes) for the
+    registered NodeStatus — the kubelet-allocatable analog the binder's
+    fit filters consume. None (not 0) when the host doesn't expose it:
+    unreported capacity must skip the fit check, not fail it."""
+    cpu = os.cpu_count()
+    cpu_millis = cpu * 1000 if cpu else None
+    mem_bytes: Optional[int] = None
+    try:
+        pages = os.sysconf("SC_PHYS_PAGES")
+        page_size = os.sysconf("SC_PAGE_SIZE")
+        if pages > 0 and page_size > 0:
+            mem_bytes = pages * page_size
+    except (ValueError, OSError, AttributeError):
+        pass
+    return cpu_millis, mem_bytes
 
 
 def main(argv=None) -> int:

@@ -35,6 +35,7 @@ import json
 import logging
 import os
 import random
+import socket
 import ssl
 import tempfile
 import threading
@@ -59,6 +60,7 @@ from tf_operator_tpu.api.types import (
     Pod,
     PodSpec,
     PodStatus,
+    Taint,
     Toleration,
     TPUJob,
 )
@@ -502,6 +504,9 @@ def k8s_resource_version(d: dict) -> str:
     return str((d.get("metadata") or {}).get("resourceVersion", "") or "")
 
 
+RELAY_VOLUME_NAME = "tpu-operator-relay"
+
+
 def pod_to_k8s(pod: Pod) -> dict:
     containers = []
     for c in pod.spec.containers:
@@ -524,11 +529,21 @@ def pod_to_k8s(pod: Pod) -> dict:
             # Flat resource map -> limits (covers google.com/tpu chip
             # requests; K8s defaults requests from limits).
             kc["resources"] = {"limits": dict(c.resources)}
+        if pod.spec.relay_dir:
+            # The node-agent relay volume, mounted at the SAME path the
+            # agent sees on the host so the TPUJOB_*_FILE env renders
+            # one path valid in both mount namespaces.
+            kc["volumeMounts"] = [{"name": RELAY_VOLUME_NAME,
+                                   "mountPath": pod.spec.relay_dir}]
         containers.append(kc)
     restart = pod.spec.restart_policy
     if restart not in _K8S_RESTART_POLICIES:
         restart = "Never"
     spec: dict = {"containers": containers, "restartPolicy": restart}
+    if pod.spec.relay_dir:
+        spec["volumes"] = [{"name": RELAY_VOLUME_NAME,
+                            "hostPath": {"path": pod.spec.relay_dir,
+                                         "type": "DirectoryOrCreate"}}]
     if pod.spec.scheduler_name:
         spec["schedulerName"] = pod.spec.scheduler_name
     if pod.spec.node_selector:
@@ -586,6 +601,11 @@ def _container_status_from_k8s(cs: dict) -> ContainerStatus:
 def pod_from_k8s(d: dict) -> Pod:
     spec_d = d.get("spec") or {}
     status_d = d.get("status") or {}
+    relay_dir = ""
+    for vol in spec_d.get("volumes") or []:
+        if vol.get("name") == RELAY_VOLUME_NAME:
+            relay_dir = (vol.get("hostPath") or {}).get("path", "")
+            break
     spec = PodSpec(
         containers=[_container_from_k8s(kc)
                     for kc in spec_d.get("containers") or []],
@@ -600,6 +620,7 @@ def pod_from_k8s(d: dict) -> Pod:
             toleration_seconds=t.get("tolerationSeconds"))
             for t in spec_d.get("tolerations") or []],
         node_name=spec_d.get("nodeName", ""),
+        relay_dir=relay_dir,
     )
     status = PodStatus(
         phase=status_d.get("phase", "Pending"),
@@ -658,7 +679,15 @@ def tpujob_from_k8s(d: dict) -> TPUJob:
 def node_from_k8s(d: dict) -> Node:
     """core/v1 Node -> the framework Node the agent registry also uses:
     allocatable google.com/tpu chips become spec.chips, the ICI-domain
-    label rides metadata.labels, cordon maps onto spec.unschedulable."""
+    label rides metadata.labels, cordon maps onto spec.unschedulable.
+    Taints and allocatable cpu/mem feed the binder's hard placement
+    filters; the node agent's heartbeat annotation feeds the operator's
+    barrier-capability check (docs/node-agent.md)."""
+    from tf_operator_tpu.controller.binder import (
+        parse_cpu_quantity_millis,
+        parse_memory_quantity_bytes,
+    )
+
     meta = _meta_from_k8s(d.get("metadata") or {})
     meta.namespace = ""  # cluster-scoped
     spec_d = d.get("spec") or {}
@@ -668,9 +697,9 @@ def node_from_k8s(d: dict) -> Node:
         if addr.get("type") == "InternalIP":
             address = addr.get("address", "")
             break
+    allocatable = status_d.get("allocatable") or {}
     try:
-        chips = int(float((status_d.get("allocatable") or {})
-                          .get(constants.RESOURCE_TPU, 0) or 0))
+        chips = int(float(allocatable.get(constants.RESOURCE_TPU, 0) or 0))
     except ValueError:
         chips = 0
     conditions: Dict[str, str] = {}
@@ -683,12 +712,23 @@ def node_from_k8s(d: dict) -> Node:
     # to Ready would put its chips into the gang admission budget and
     # let the binder target a node nothing is serving on.
     ready = "Ready" if conditions.get("Ready") == "True" else "NotReady"
+    taints = [Taint(key=t.get("key", ""), value=t.get("value", ""),
+                    effect=t.get("effect", ""))
+              for t in spec_d.get("taints") or []]
     return Node(metadata=meta,
                 spec=NodeSpec(address=address, chips=chips,
                               labels=dict(meta.labels),
                               unschedulable=bool(
-                                  spec_d.get("unschedulable"))),
-                status=NodeStatus(phase=ready, conditions=conditions))
+                                  spec_d.get("unschedulable")),
+                              taints=taints),
+                status=NodeStatus(
+                    phase=ready, conditions=conditions,
+                    last_heartbeat=parse_time(meta.annotations.get(
+                        constants.ANNOTATION_AGENT_HEARTBEAT)),
+                    allocatable_cpu_millis=parse_cpu_quantity_millis(
+                        allocatable.get("cpu")),
+                    allocatable_memory_bytes=parse_memory_quantity_bytes(
+                        allocatable.get("memory"))))
 
 
 FROM_K8S: Dict[str, Callable[[dict], object]] = {
@@ -874,8 +914,18 @@ class _Reflector:
     def stop(self) -> None:
         self._stop.set()
         # Abort a blocking watch read so shutdown doesn't wait out the
-        # stream timeout.
+        # stream timeout. close() alone is not enough: it only drops the
+        # fd reference, and a recv() already blocked inside the reflector
+        # thread keeps the socket alive until the server's next keepalive
+        # tick — shutdown() wakes that read immediately.
         for resp in self._resp_box:
+            sock = getattr(getattr(resp, "fp", None), "raw", None)
+            sock = getattr(sock, "_sock", None)
+            if sock is not None:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
             try:
                 resp.close()
             except OSError:
@@ -1197,7 +1247,13 @@ class KubeOperator:
                  health_drain_grace_seconds: float = 0.0,
                  config: Optional[EngineConfig] = None,
                  post_events: bool = True,
-                 degraded_after_seconds: float = 10.0):
+                 degraded_after_seconds: float = 10.0,
+                 enable_tenant_queues: bool = False,
+                 queue_config: Optional[str] = None,
+                 enable_ckpt_coordination: bool = False,
+                 enable_serving: bool = False,
+                 relay_dir: str = "",
+                 agent_heartbeat_staleness_seconds: float = 30.0):
         from tf_operator_tpu.runtime.retry import ControlPlaneHealth
 
         self.client = client
@@ -1210,15 +1266,65 @@ class KubeOperator:
         # reconciling but defers new drains/reclaims/preemptions.
         self.cp_health = ControlPlaneHealth(
             threshold_seconds=degraded_after_seconds)
+        if enable_tenant_queues and not enable_gang_scheduling:
+            raise ValueError("tenant queues sit above gang admission: "
+                             "--enable-tenant-queues requires "
+                             "--enable-gang-scheduling")
+        self.agent_heartbeat_staleness_seconds = \
+            agent_heartbeat_staleness_seconds
+        self.quota = None
+        self.ckpt = None
+        self.serving = None
+        # (ns, pod) -> last ckpt-state annotation payload mirrored into
+        # a CheckpointRecord (relist dedup for _on_pod_relay_event).
+        self._ckpt_state_seen: Dict[Tuple[str, str], str] = {}
+        if enable_ckpt_coordination:
+            from tf_operator_tpu.controller.ckpt import (
+                CheckpointCoordinator,
+            )
+
+            # Notice stamps go through the API server (the store is an
+            # informer mirror — a direct write would be clobbered by
+            # the next relist), and barrier opening is gated on fresh
+            # node-agent heartbeats: a node without a live relay can't
+            # deliver the notice, so the drain degrades to plain
+            # eviction instead of waiting out a doomed barrier.
+            self.ckpt = CheckpointCoordinator(
+                self.store, recorder=recorder, namespace=namespace,
+                annotate_pod=self._annotate_pod,
+                barrier_capable=self._barrier_capable)
+        if enable_serving:
+            from tf_operator_tpu.controller.serving import ServingManager
+
+            self.serving = ServingManager(self.store, recorder=recorder,
+                                          namespace=namespace)
         gang = None
         if enable_gang_scheduling:
             config.enable_gang_scheduling = True
+            if enable_tenant_queues:
+                from tf_operator_tpu.controller.quota import (
+                    TenantQueueManager,
+                    load_queue_config,
+                    seed_queues,
+                )
+
+                # Queues/ClusterQueues are operator-internal kinds (no
+                # CRD): on kube they live in the in-memory store and are
+                # seeded from --queue-config (docs/quota.md Scope).
+                self.quota = TenantQueueManager(self.store,
+                                                recorder=recorder)
+                if queue_config:
+                    seed_queues(self.store,
+                                *load_queue_config(queue_config))
             gang = SliceGangScheduler(self.store, total_chips=total_chips,
                                       fairness=gang_fairness,
                                       aging_seconds=gang_aging_seconds,
                                       priority_classes=gang_priority_classes,
                                       queue_quotas=gang_queue_quotas,
                                       preemption=gang_preemption,
+                                      quota=self.quota,
+                                      ckpt=self.ckpt,
+                                      recorder=recorder,
                                       # Node-bound Pending pods (container
                                       # creating) already hold chips here;
                                       # nothing stamps gang_released on
@@ -1250,7 +1356,14 @@ class KubeOperator:
         self.controller = KubeJobController(client, store=self.store,
                                             recorder=recorder, config=config,
                                             gang=gang, namespace=namespace,
-                                            cp_health=self.cp_health)
+                                            cp_health=self.cp_health,
+                                            ckpt=self.ckpt,
+                                            serving=self.serving,
+                                            relay_dir=relay_dir)
+        if self.ckpt is not None and gang is not None:
+            # A barrier ack landing between resyncs must release the
+            # held eviction promptly: record writes poke admission.
+            self.ckpt.on_ack = gang.readmit
         # Pods/services are watched UNSELECTED (upstream controller
         # style): a selector watch would drop an owned pod from the cache
         # the moment its group label is edited away, making it invisible
@@ -1263,12 +1376,26 @@ class KubeOperator:
         ]
         self.binder = None
         self.health = None
+        # Nodes are cluster-scoped: informer namespace is always None.
+        # The binder needs them for placement; the checkpoint
+        # coordinator needs them for agent-heartbeat freshness even
+        # without the binder.
+        if (enable_gang_scheduling and gang_binder) \
+                or enable_ckpt_coordination:
+            self.informers.append(
+                KubeInformer(client, self.store, store_mod.NODES, None))
+        self._relay_watcher = None
+        if enable_ckpt_coordination:
+            # The node agent mirrors each worker's checkpoint file onto
+            # the pod's ckpt-state annotation; the PODS informer carries
+            # it here, where it becomes the pod's (in-memory)
+            # CheckpointRecord — the same object the local data plane
+            # publishes directly (runtime/relay.py).
+            self._relay_watcher = self.store.watch(
+                store_mod.PODS, self._on_pod_relay_event)
         if enable_gang_scheduling and gang_binder:
             from tf_operator_tpu.controller.binder import SliceGangBinder
 
-            # Nodes are cluster-scoped: informer namespace is always None.
-            self.informers.append(
-                KubeInformer(client, self.store, store_mod.NODES, None))
             self.binder = SliceGangBinder(self.store, client, gang,
                                           namespace=namespace,
                                           recorder=recorder)
@@ -1285,7 +1412,73 @@ class KubeOperator:
                     pod_control=self.controller.engine.pod_control,
                     recorder=recorder, namespace=namespace,
                     default_grace_seconds=health_drain_grace_seconds,
-                    cp_health=self.cp_health)
+                    ckpt=self.ckpt, cp_health=self.cp_health)
+
+    # -- node-agent relay plumbing (docs/node-agent.md) ------------------
+
+    def _annotate_pod(self, namespace: str, name: str,
+                      annotations: Dict[str, str]) -> None:
+        """Checkpoint-coordinator stamp hook: annotations go through the
+        API server (merge PATCH); the informer mirrors them back and the
+        node agent's own watch relays them to the worker."""
+        self.client.patch(store_mod.PODS, namespace, name,
+                          {"metadata": {"annotations": dict(annotations)}})
+
+    def _barrier_capable(self, pods) -> bool:
+        """A gang is barrier-capable only when EVERY node hosting one of
+        its live pods has a fresh node-agent heartbeat — otherwise the
+        preemption notice would never reach some worker as a file and
+        the barrier could only time out. Unbound pods have no relay
+        either. Degrading (returning False) reproduces today's
+        no-coordination eviction exactly (docs/node-agent.md)."""
+        import datetime as _dt
+
+        node_names = {p.spec.node_name for p in pods if p.spec.node_name}
+        if not node_names:
+            return False
+        now = _dt.datetime.now(_dt.timezone.utc)
+        for node_name in node_names:
+            node = self.store.try_get(store_mod.NODES, "", node_name)
+            if node is None or node.status.last_heartbeat is None:
+                return False
+            hb = node.status.last_heartbeat
+            if hb.tzinfo is None:
+                hb = hb.replace(tzinfo=_dt.timezone.utc)
+            if (now - hb).total_seconds() \
+                    > self.agent_heartbeat_staleness_seconds:
+                return False
+        return True
+
+    def _on_pod_relay_event(self, etype: str, pod: Pod) -> None:
+        """Convert the agent-mirrored ckpt-state annotation into the
+        pod's CheckpointRecord (operator-internal kind — lives only in
+        this in-memory store, so the informer can't clobber it)."""
+        key = (pod.metadata.namespace, pod.metadata.name)
+        if etype == store_mod.DELETED:
+            self._ckpt_state_seen.pop(key, None)
+            return
+        raw = pod.metadata.annotations.get(
+            constants.ANNOTATION_CKPT_STATE, "")
+        if not raw or self._ckpt_state_seen.get(key) == raw:
+            return
+        try:
+            data = json.loads(raw)
+        except ValueError:
+            return
+        if not isinstance(data, dict):
+            return
+        import datetime as _dt
+
+        from tf_operator_tpu.runtime import relay as relay_mod
+
+        try:
+            if relay_mod.upsert_checkpoint_record(
+                    self.store, pod, data,
+                    _dt.datetime.now(_dt.timezone.utc)):
+                self._ckpt_state_seen[key] = raw
+        except Exception:
+            log.debug("ckpt-state mirror for %s/%s failed", *key,
+                      exc_info=True)
 
     def _cluster_chip_capacity(self) -> int:
         """Gang admission budget from live node inventory: allocatable
@@ -1335,6 +1528,8 @@ class KubeOperator:
             if not inf.synced.wait(timeout=sync_timeout):
                 raise TimeoutError(f"informer {inf.kind} never synced "
                                    f"(API server unreachable?)")
+        if self.ckpt is not None:
+            self.ckpt.start()
         self.controller.run(threadiness=threadiness)
         if self.binder is not None:
             self.binder.start()
@@ -1348,6 +1543,11 @@ class KubeOperator:
         if self.binder is not None:
             self.binder.stop()
         self.controller.stop()
+        if self.ckpt is not None:
+            self.ckpt.stop()
+        if self._relay_watcher is not None:
+            self._relay_watcher.stop()
+            self._relay_watcher = None
         for inf in self.informers:
             inf.stop()
         self.store.stop_watchers()

@@ -442,26 +442,40 @@ class FakeKubeState:
 
     def add_node(self, name: str, chips: int = 8, ici_domain: str = "",
                  labels: Optional[Dict[str, str]] = None,
-                 unschedulable: bool = False, ready: bool = True) -> dict:
+                 unschedulable: bool = False, ready: bool = True,
+                 taints: Optional[list] = None,
+                 cpu: Optional[str] = None,
+                 memory: Optional[str] = None) -> dict:
         """Register a core/v1 Node the way a kubelet + TPU device plugin
         would: allocatable google.com/tpu chips plus the ICI-domain
         label the gang binder keys slice affinity on. A heartbeating
         kubelet reports a Ready condition (``ready=False`` models a dead
         kubelet; a node with NO Ready condition at all — kubelet never
-        heartbeated — is built by passing ``ready=None``)."""
+        heartbeated — is built by passing ``ready=None``). ``taints``
+        is a list of core/v1 taint dicts ({key, value, effect});
+        ``cpu``/``memory`` are allocatable quantity strings ("4",
+        "500m", "16Gi") — binds violating any of these are rejected the
+        way kubelet/kube-scheduler would reject them (422)."""
         node_labels = dict(labels or {})
         if ici_domain:
             node_labels[constants.LABEL_ICI_DOMAIN] = ici_domain
-        status: dict = {"allocatable": {
-            constants.RESOURCE_TPU: str(chips)},
-            "addresses": [{"type": "InternalIP",
-                           "address": "10.0.0.1"}]}
+        allocatable: dict = {constants.RESOURCE_TPU: str(chips)}
+        if cpu is not None:
+            allocatable["cpu"] = str(cpu)
+        if memory is not None:
+            allocatable["memory"] = str(memory)
+        status: dict = {"allocatable": allocatable,
+                        "addresses": [{"type": "InternalIP",
+                                       "address": "10.0.0.1"}]}
         if ready is not None:
             status["conditions"] = [{"type": "Ready",
                                      "status": "True" if ready else "False"}]
+        spec: dict = {"unschedulable": unschedulable}
+        if taints:
+            spec["taints"] = [dict(t) for t in taints]
         obj = {"apiVersion": "v1", "kind": "Node",
                "metadata": {"name": name, "labels": node_labels},
-               "spec": {"unschedulable": unschedulable},
+               "spec": spec,
                "status": status}
         return self.create("nodes", "", obj)
 
@@ -509,7 +523,12 @@ class FakeKubeState:
     def bind_pod(self, ns: str, name: str, node: str) -> dict:
         """Bindings-API core: assign the pod to a node exactly once (a
         real apiserver 409s a second bind — two schedulers racing must
-        not silently reassign a placed pod)."""
+        not silently reassign a placed pod). Binds kubelet or the taint
+        manager would reject — untolerated NoSchedule/NoExecute taints,
+        unmatched nodeSelector, cpu/mem requests over what's left of the
+        node's allocatable — are refused with 422, so a binder that
+        skips its own hard filters fails loudly in tier-1 instead of
+        placing pods a real cluster would evict."""
         with self.lock:
             pod = self.objects["pods"].get((ns, name))
             if pod is None:
@@ -519,9 +538,44 @@ class FakeKubeState:
                 raise _HttpError(
                     409, "Conflict",
                     f"pod {ns}/{name} is already assigned to node {current}")
+            node_obj = self.objects["nodes"].get(("", node))
+            if node_obj is not None:
+                reason = self._bind_rejection(pod, node_obj, node)
+                if reason:
+                    raise _HttpError(
+                        422, "Invalid",
+                        f"pod {ns}/{name} cannot bind: {reason}")
             self.patch("pods", ns, name, {"spec": {"nodeName": node}})
         return _status_body(201, "Created", f"{name} bound to {node}") | {
             "status": "Success"}
+
+    def _bind_rejection(self, pod_raw: dict, node_raw: dict,
+                        node_name: str) -> Optional[str]:
+        """Run the binder's own hard predicate over the k8s-shaped
+        objects (converted through the production parsers — the fake
+        validates the SAME contract the operator filters on, so the two
+        cannot drift). Caller holds the lock."""
+        from tf_operator_tpu.controller import binder as binder_mod
+        from tf_operator_tpu.runtime.kube import node_from_k8s, pod_from_k8s
+
+        pod = pod_from_k8s(pod_raw)
+        node = node_from_k8s(node_raw)
+        free_cpu = node.status.allocatable_cpu_millis
+        free_mem = node.status.allocatable_memory_bytes
+        if free_cpu is not None or free_mem is not None:
+            for (_, _), other in self.objects["pods"].items():
+                spec = other.get("spec") or {}
+                if spec.get("nodeName") != node_name:
+                    continue
+                if ((other.get("status") or {}).get("phase", "")
+                        in ("Succeeded", "Failed")):
+                    continue
+                op = pod_from_k8s(other)
+                if free_cpu is not None:
+                    free_cpu -= binder_mod.pod_cpu_millis(op)
+                if free_mem is not None:
+                    free_mem -= binder_mod.pod_memory_bytes(op)
+        return binder_mod.node_rejects_pod(pod, node, free_cpu, free_mem)
 
     def set_all_pods_phase(self, ns: str, phase: str, *,
                            selector: Optional[Dict[str, str]] = None) -> int:

@@ -37,6 +37,7 @@ from tf_operator_tpu.api.types import (
     PodStatus,
     RestartPolicy,
 )
+from tf_operator_tpu.runtime import relay as relay_mod
 from tf_operator_tpu.runtime import store as store_mod
 from tf_operator_tpu.runtime.store import ADDED, DELETED, MODIFIED, Store
 
@@ -227,13 +228,11 @@ class LocalProcessBackend:
                                  daemon=True).start()
             # Log retention follows the pod object (kubelet semantics);
             # the checkpoint-coordination sidecar files follow it too.
-            for path in (self.pod_log_path(pod),
-                         self.pod_preempt_path(pod),
-                         self.pod_ckpt_path(pod)):
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
+            try:
+                os.unlink(self.pod_log_path(pod))
+            except OSError:
+                pass
+            relay_mod.cleanup(self.log_dir, pod)
 
     # ------------------------------------------------------------------
 
@@ -363,24 +362,17 @@ class LocalProcessBackend:
 
     def pod_preempt_path(self, pod: Pod) -> str:
         """Where this pod's worker process finds a preemption notice
-        (uid-keyed like the log: a recreated pod must never read the
-        dead incarnation's notice and 'ack' a barrier it never saved
-        under)."""
-        uid = (pod.metadata.uid or "nouid")[:8]
-        return os.path.join(
-            self.log_dir,
-            f"{pod.metadata.namespace}.{pod.metadata.name}.{uid}"
-            ".preempt.json")
+        (incarnation-keyed like the log: a recreated pod must never read
+        the dead incarnation's notice and 'ack' a barrier it never saved
+        under). Path derivation is shared with the kube node agent
+        (runtime/relay.py)."""
+        return relay_mod.preempt_path(self.log_dir, pod)
 
     def pod_ckpt_path(self, pod: Pod) -> str:
         """Where this pod's worker process publishes checkpoint state
         (saves / barrier acks / restore confirmation) for the plane to
         mirror into its CheckpointRecord."""
-        uid = (pod.metadata.uid or "nouid")[:8]
-        return os.path.join(
-            self.log_dir,
-            f"{pod.metadata.namespace}.{pod.metadata.name}.{uid}"
-            ".ckpt.json")
+        return relay_mod.ckpt_path(self.log_dir, pod)
 
     def _forward_notice(self, rp: _RunningPod, pod: Pod) -> None:
         """Write the pod's preemption-notice annotation to the worker's
@@ -391,18 +383,11 @@ class LocalProcessBackend:
 
         notice = pod.metadata.annotations.get(
             _c.ANNOTATION_PREEMPT_NOTICE, "")
-        if not notice or rp.notice_written == notice:
-            return
-        path = self.pod_preempt_path(rp.pod)
         try:
-            with open(path + ".tmp", "w") as f:
-                f.write(notice)
-            os.replace(path + ".tmp", path)
+            rp.notice_written = relay_mod.forward_notice(
+                self.log_dir, rp.pod, notice, rp.notice_written)
         except OSError:
             return  # next MODIFIED/poll retries
-        rp.notice_written = notice
-        log.info("preemption notice forwarded to pod %s/%s",
-                 pod.metadata.namespace, pod.metadata.name)
 
     def _mirror_ckpt_record(self, rp: _RunningPod) -> None:
         """Mirror the worker's checkpoint file into its CheckpointRecord
@@ -411,62 +396,14 @@ class LocalProcessBackend:
         barriers and derive restore steps). A partially-written or
         unparseable file is skipped; the next tick retries."""
         pod = rp.pod
-        path = self.pod_ckpt_path(pod)
-        try:
-            mtime = os.stat(path).st_mtime_ns
-        except OSError:
+        data, rp.ckpt_mtime = relay_mod.read_ckpt_file(
+            self.pod_ckpt_path(pod), rp.ckpt_mtime)
+        if data is None:
             return
-        if mtime == rp.ckpt_mtime:
-            return
-        import json as _json
-
         try:
-            with open(path) as f:
-                data = _json.load(f)
-        except (OSError, ValueError):
-            return
-        rp.ckpt_mtime = mtime
-        from tf_operator_tpu.api import constants as _c
-        from tf_operator_tpu.api.types import (
-            CheckpointRecord,
-            CheckpointRecordStatus,
-            ObjectMeta,
-        )
-
-        restored = data.get("restored_from_step")
-        status = CheckpointRecordStatus(
-            step=int(data.get("step", -1)),
-            progress_step=int(data.get("progress_step",
-                                       data.get("step", -1))),
-            barrier_id=str(data.get("barrier", "")),
-            directory=str(data.get("directory", "")),
-            save_seconds=float(data.get("save_seconds", 0.0)),
-            restored_from_step=(int(restored) if restored is not None
-                                else None),
-            updated_at=_now())
-        ns, name = pod.metadata.namespace, pod.metadata.name
-        try:
-            existing = self.store.try_get(store_mod.CHECKPOINTRECORDS,
-                                          ns, name)
-            if existing is None:
-                record = CheckpointRecord(
-                    metadata=ObjectMeta(
-                        name=name, namespace=ns,
-                        labels={k: v for k, v in pod.metadata.labels.items()
-                                if k in (_c.LABEL_JOB_NAME,
-                                         _c.LABEL_REPLICA_TYPE,
-                                         _c.LABEL_REPLICA_INDEX)},
-                        owner_references=[r.deepcopy() for r in
-                                          pod.metadata.owner_references]),
-                    status=status)
-                self.store.create(store_mod.CHECKPOINTRECORDS, record)
-            else:
-                existing.status = status
-                self.store.update_status(store_mod.CHECKPOINTRECORDS,
-                                         existing)
-        except (store_mod.AlreadyExistsError, store_mod.ConflictError,
-                store_mod.NotFoundError):
-            rp.ckpt_mtime = 0  # lost a race; next tick re-mirrors
+            if not relay_mod.upsert_checkpoint_record(
+                    self.store, pod, data, _now()):
+                rp.ckpt_mtime = 0  # lost a race; next tick re-mirrors
         except Exception:
             log.debug("checkpoint record mirror failed", exc_info=True)
             rp.ckpt_mtime = 0

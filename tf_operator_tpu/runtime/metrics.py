@@ -468,6 +468,17 @@ chaos_faults_injected = REGISTRY.counter(
     "tpu_operator_chaos_faults_injected_total",
     "Faults the chaos layer injected (runtime/chaos.py FaultProfile; "
     "test/bench harnesses only — always 0 in production)", ["fault"])
+node_agent_heartbeats = REGISTRY.counter(
+    "tpu_operator_node_agent_heartbeats_total",
+    "Heartbeats a node agent successfully published to the control "
+    "plane (served: NodeStatus.last_heartbeat write; kube: "
+    "agent-heartbeat annotation PATCH)", ["node"])
+node_agent_relay_errors = REGISTRY.counter(
+    "tpu_operator_node_agent_relay_errors_total",
+    "Node-agent relay operations that failed after retries, by kind "
+    "(notice_write = preemption notice file, ckpt_read = worker "
+    "checkpoint state file, ckpt_patch = ckpt-state annotation PATCH)",
+    ["kind"])
 trace_spans_dropped = REGISTRY.counter(
     "tpu_operator_trace_spans_dropped_total",
     "Spans of completed traces the flight recorder did NOT retain "
