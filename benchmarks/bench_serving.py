@@ -17,38 +17,52 @@ weighted lanes, so queue_depth_max reflects genuine burst backpressure
 rather than lock-step submission.
 
 Runner "fake" is the deterministic jax-free generator (tier-1 smoke,
-pinned by tests/test_bench_serving.py); "llama" runs the real
-incremental-decode path on a tiny model.
+pinned by tests/test_bench_serving.py); "llama"/"mixtral" run the real
+incremental-decode paths on tiny models (runners come from the
+serve/worker.py registry — the bench and the production worker share
+one factory).
+
+``--scenario diurnal`` is the autoscaler proof (ROADMAP item 3(a)
+acceptance; docs/serving.md): a diurnal/bursty request trace is fed
+through the REAL HTTP gateway into a spool, and the SAME trace runs
+twice — once with the serving autoscaler governing an elastic gang
+through the real gang-scheduler resize pass, once statically
+provisioned at the peak slice count. Serving capacity is a rate-based
+fleet simulator over the spool (capacity tracks the job's live
+``numSlices``, with a restart pause while a resize settles — real
+tiny-model decode on one CPU host would not scale with slice count,
+the same honesty trade as bench_controlplane's WorkUnitKubelet). The
+artifact compares chip-seconds integrals and p99 TTFT against the
+job's `ttftP99SloSeconds`; the acceptance floor at the default shape
+is >=30% chip-seconds saved with the SLO held and zero dropped
+requests across every resize.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
+import os
 import random
 import sys
+import tempfile
+import threading
 import time
+import urllib.error
+import urllib.request
 
-REPO = __import__("os").path.dirname(
-    __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 from tf_operator_tpu.runtime import metrics  # noqa: E402
-from tf_operator_tpu.serve.batcher import (  # noqa: E402
-    ContinuousBatcher,
-    FakeRunner,
-)
+from tf_operator_tpu.runtime import retry as retry_mod  # noqa: E402
+from tf_operator_tpu.runtime import store as store_mod  # noqa: E402
+from tf_operator_tpu.serve.batcher import ContinuousBatcher  # noqa: E402
 from tf_operator_tpu.serve.engine import ServingEngine  # noqa: E402
 from tf_operator_tpu.serve.queue import Request, RequestQueue  # noqa: E402
-
-
-def build_runner(kind: str, slots: int):
-    if kind == "fake":
-        return FakeRunner(max_slots=slots)
-    from tf_operator_tpu.serve.runner import LlamaRunner
-
-    return LlamaRunner(max_slots=slots)
+from tf_operator_tpu.serve.worker import RUNNERS, build_runner  # noqa: E402
 
 
 def bench_environment() -> dict:
@@ -122,7 +136,7 @@ def run_bench(args) -> dict:
               "tenants": args.tenants, "max_queue": args.max_queue,
               "max_prompt": args.max_prompt,
               "max_new_tokens": args.max_new_tokens, "seed": args.seed}
-    label = "fake" if args.runner == "fake" else "llama-tiny"
+    label = "fake" if args.runner == "fake" else f"{args.runner}-tiny"
     return {
         "metric": f"serving_tokens_per_sec[{label}]",
         "value": round(engine.tokens_total / elapsed, 2) if elapsed else 0.0,
@@ -140,10 +154,315 @@ def run_bench(args) -> dict:
     }
 
 
+# --- diurnal scenario (gateway + autoscaler vs static peak) ------------
+
+NAMESPACE = "bench"
+JOB = "bench-serving"
+
+
+def _diurnal_qps(t: float, period: float, peak_qps: float,
+                 trough_qps: float, peak_fraction: float) -> float:
+    """Square-ish diurnal trace: the first ``peak_fraction`` of every
+    period is the burst, the rest the trough."""
+    return peak_qps if (t % period) < peak_fraction * period else trough_qps
+
+
+class _FleetSim(threading.Thread):
+    """Rate-based serving-fleet simulator over a real spool.
+
+    Serves ``per_slice_rate`` requests/second per slice the job
+    currently holds (read live from the store, so resizes take effect
+    the moment the spec lands), completing pending/ files oldest-first
+    into done/ and observing each request's wait into the REAL
+    serving_ttft_seconds histogram — the autoscaler's TTFT-burn signal
+    measures genuine queueing delay. While a resize is settling
+    (SliceGroup.status.resizing_reason set) the fleet serves NOTHING
+    for ``settle_seconds`` — the world-restart cost elasticity pays —
+    then clears the marker like the engine finishing the restart.
+    Chips are held throughout (a restarting gang still owns its
+    slices), so the chip-seconds integral charges the resize window.
+    """
+
+    def __init__(self, store, spool_root: str, per_slice_rate: float,
+                 chips_per_slice: int, settle_seconds: float,
+                 tick: float = 0.005):
+        super().__init__(name="fleet-sim", daemon=True)
+        self.store = store
+        self.pending = os.path.join(spool_root, "pending")
+        self.done = os.path.join(spool_root, "done")
+        self.per_slice_rate = per_slice_rate
+        self.chips_per_slice = chips_per_slice
+        self.settle_seconds = settle_seconds
+        self.tick = tick
+        self.chip_seconds = 0.0
+        self.served = 0
+        self.slices_max_seen = 0
+        self._halt = threading.Event()
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=10)
+
+    def _slices(self) -> int:
+        job = self.store.try_get(store_mod.TPUJOBS, NAMESPACE, JOB)
+        return job.spec.slice.num_slices if job is not None else 0
+
+    def _settling(self) -> bool:
+        group = self.store.try_get(store_mod.SLICEGROUPS, NAMESPACE, JOB)
+        return group is not None and bool(group.status.resizing_reason)
+
+    def _finish_settle(self) -> None:
+        def clear(group):
+            group.status.resizing_reason = ""
+
+        retry_mod.update_with_conflict_retry(
+            self.store, store_mod.SLICEGROUPS, NAMESPACE, JOB, clear,
+            status=True, component="bench.fleet")
+
+    def _serve_one(self) -> bool:
+        oldest, oldest_mtime = None, None
+        try:
+            for n in os.listdir(self.pending):
+                if not n.endswith(".json"):
+                    continue
+                p = os.path.join(self.pending, n)
+                try:
+                    m = os.path.getmtime(p)
+                except OSError:
+                    continue
+                if oldest_mtime is None or m < oldest_mtime:
+                    oldest, oldest_mtime = p, m
+        except OSError:
+            return False
+        if oldest is None:
+            return False
+        try:
+            with open(oldest, encoding="utf-8") as f:
+                req = json.load(f)
+            os.unlink(oldest)  # claim (exclusive: single fleet thread)
+        except (OSError, ValueError):
+            return False
+        wait = max(0.0, time.time() - oldest_mtime)
+        metrics.serving_ttft_seconds.observe(wait)
+        out = {"id": req["id"], "tenant": req.get("tenant", "default"),
+               "tokens": [t % 251 for t in
+                          range(int(req.get("maxNewTokens", 1)))],
+               "servedBy": "fleet-sim", "ttftSeconds": round(wait, 6)}
+        path = os.path.join(self.done, req["id"] + ".json")
+        with open(path + ".tmp", "w", encoding="utf-8") as f:
+            json.dump(out, f)
+        os.replace(path + ".tmp", path)
+        self.served += 1
+        return True
+
+    def run(self) -> None:
+        credit = 0.0
+        last = time.monotonic()
+        while not self._halt.is_set():
+            time.sleep(self.tick)
+            now = time.monotonic()
+            dt, last = now - last, now
+            slices = self._slices()
+            self.slices_max_seen = max(self.slices_max_seen, slices)
+            self.chip_seconds += slices * self.chips_per_slice * dt
+            if self._settling():
+                # World restart: chips held, nothing served, queue
+                # grows — then the new world comes up.
+                until = now + self.settle_seconds
+                while (not self._halt.is_set()
+                       and time.monotonic() < until):
+                    time.sleep(self.tick)
+                settled = time.monotonic()
+                self.chip_seconds += (self._slices()
+                                      * self.chips_per_slice
+                                      * (settled - last))
+                last = settled
+                credit = 0.0
+                self._finish_settle()
+                continue
+            credit = min(credit + slices * self.per_slice_rate * dt,
+                         slices * self.per_slice_rate)  # no credit bank
+            while credit >= 1.0 and self._serve_one():
+                credit -= 1.0
+
+
+def _gateway_post(url: str, payload: dict, results: dict,
+                  lock: threading.Lock) -> None:
+    data = json.dumps(payload).encode()
+    req = urllib.request.Request(url, data=data, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            resp.read()  # consume the full NDJSON stream
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    except Exception:
+        code = -1
+    with lock:
+        results[code] = results.get(code, 0) + 1
+
+
+def _diurnal_once(autoscale: bool, args) -> dict:
+    """One full trace through gateway + spool + fleet; autoscale=False
+    pins the gang at the peak slice count (the static baseline)."""
+    from tf_operator_tpu import testutil
+    from tf_operator_tpu.api.defaults import set_defaults
+    from tf_operator_tpu.api.types import (
+        ServingPolicy,
+        SliceGroup,
+        SliceGroupSpec,
+        SliceGroupStatus,
+        TPUSliceSpec,
+    )
+    from tf_operator_tpu.controller.autoscaler import ServingAutoscaler
+    from tf_operator_tpu.controller.gang import (
+        PHASE_RUNNING,
+        SliceGangScheduler,
+    )
+    from tf_operator_tpu.runtime.store import Store
+    from tf_operator_tpu.serve.gateway import GatewayServer
+
+    metrics.REGISTRY.reset()
+    rng = random.Random(args.seed)
+    spool = tempfile.mkdtemp(prefix="bench-diurnal-")
+    peak_slices = max(1, math.ceil(args.peak_qps / args.per_slice_rate))
+    chips_per_slice = 4
+
+    store = Store()
+    job = testutil.new_tpujob(worker=0, name=JOB, namespace=NAMESPACE)
+    job.spec.slice.accelerator = f"v5e-{chips_per_slice}"
+    job.spec.slice.num_slices = 1 if autoscale else peak_slices
+    job.spec.slice.min_slices = 1
+    job.spec.slice.max_slices = peak_slices
+    job.spec.run_policy.serving_policy = ServingPolicy(
+        enabled=True, spool_directory=spool,
+        max_queue_depth=args.max_queue,
+        ttft_p99_slo_seconds=args.ttft_slo,
+        target_queue_depth_per_slice=args.target_depth_per_slice,
+        scale_down_cooldown_seconds=args.cooldown)
+    set_defaults(job)
+    store.create(store_mod.TPUJOBS, job)
+    group = SliceGroup(
+        spec=SliceGroupSpec(
+            min_member=job.spec.slice.num_slices,
+            slice=TPUSliceSpec(accelerator=job.spec.slice.accelerator,
+                               num_slices=job.spec.slice.num_slices,
+                               min_slices=1, max_slices=peak_slices)),
+        status=SliceGroupStatus(phase=PHASE_RUNNING))
+    group.metadata.name = JOB
+    group.metadata.namespace = NAMESPACE
+    store.create(store_mod.SLICEGROUPS, group)
+
+    autoscaler = None
+    if autoscale:
+        autoscaler = ServingAutoscaler(
+            store, None, namespace=NAMESPACE,
+            interval_seconds=args.autoscale_interval)
+        gang = SliceGangScheduler(store, elastic=True,
+                                  resize_signals=autoscaler.signals)
+        autoscaler.gang = gang
+
+    fleet = _FleetSim(store, spool, per_slice_rate=args.per_slice_rate,
+                      chips_per_slice=chips_per_slice,
+                      settle_seconds=args.settle_seconds)
+    gateway = GatewayServer(spool, port=0, max_queue_depth=args.max_queue,
+                            timeout_seconds=30.0)
+    gateway.start()
+    fleet.start()
+    if autoscaler is not None:
+        autoscaler.start()
+
+    url = f"http://127.0.0.1:{gateway.port}/v1/generate"
+    results: dict = {}
+    lock = threading.Lock()
+    clients = []
+    duration = args.periods * args.period
+    t0 = time.monotonic()
+    submitted = 0
+    try:
+        while True:
+            t = time.monotonic() - t0
+            if t >= duration:
+                break
+            qps = _diurnal_qps(t, args.period, args.peak_qps,
+                               args.trough_qps, args.peak_fraction)
+            prompt_len = 1 + rng.randrange(args.max_prompt)
+            payload = {"prompt": [rng.randrange(200)
+                                  for _ in range(prompt_len)],
+                       "maxNewTokens": args.max_new_tokens}
+            c = threading.Thread(target=_gateway_post,
+                                 args=(url, payload, results, lock),
+                                 daemon=True)
+            c.start()
+            clients.append(c)
+            submitted += 1
+            # Open-loop arrivals with +-50% jitter around 1/qps.
+            time.sleep((1.0 / qps) * (0.5 + rng.random()))
+        for c in clients:
+            c.join(timeout=60)
+        elapsed = time.monotonic() - t0
+    finally:
+        if autoscaler is not None:
+            autoscaler.stop()
+        fleet.stop()
+        gateway.stop()
+        store.stop_watchers()
+
+    grow = metrics.gang_resizes.value(direction="grow", reason="autoscale")
+    shrink = metrics.gang_resizes.value(direction="shrink",
+                                        reason="autoscale")
+    p99 = metrics.serving_ttft_seconds.quantile(0.99)
+    completed = results.get(200, 0)
+    return {
+        "submitted": submitted,
+        "completed": completed,
+        "rejected_429": results.get(429, 0),
+        "dropped": submitted - completed - results.get(429, 0),
+        "chip_seconds": round(fleet.chip_seconds, 3),
+        "slices_peak": peak_slices,
+        "slices_max_seen": fleet.slices_max_seen,
+        "ttft_p99_s": round(p99, 6) if p99 is not None else None,
+        "resizes_grow": int(grow),
+        "resizes_shrink": int(shrink),
+        "elapsed_s": round(elapsed, 3),
+    }
+
+
+def run_diurnal(args) -> dict:
+    auto = _diurnal_once(True, args)
+    static = _diurnal_once(False, args)
+    saved = (1.0 - auto["chip_seconds"] / static["chip_seconds"]
+             if static["chip_seconds"] else 0.0)
+    slo_met = (auto["ttft_p99_s"] is not None
+               and auto["ttft_p99_s"] <= args.ttft_slo)
+    config = {"scenario": "diurnal", "period": args.period,
+              "periods": args.periods, "peak_qps": args.peak_qps,
+              "trough_qps": args.trough_qps,
+              "peak_fraction": args.peak_fraction,
+              "per_slice_rate": args.per_slice_rate,
+              "settle_seconds": args.settle_seconds,
+              "target_depth_per_slice": args.target_depth_per_slice,
+              "cooldown": args.cooldown, "ttft_slo": args.ttft_slo,
+              "seed": args.seed}
+    return {
+        "metric": "serving_diurnal_chip_seconds_saved",
+        "value": round(saved * 100.0, 1),
+        "unit": "percent",
+        "slo_s": args.ttft_slo,
+        "slo_met": slo_met,
+        "autoscale": auto,
+        "static": static,
+        "env": bench_environment(),
+        "config_fingerprint": config_fingerprint(config),
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
+    parser.add_argument("--scenario", default="throughput",
+                        choices=("throughput", "diurnal"))
     parser.add_argument("--runner", default="fake",
-                        choices=("fake", "llama"))
+                        choices=tuple(sorted(RUNNERS)))
     parser.add_argument("--requests", type=int, default=400)
     parser.add_argument("--qps", type=float, default=2000.0,
                         help="open-loop arrival rate (0 = submit "
@@ -154,15 +473,39 @@ def main(argv=None) -> int:
     parser.add_argument("--max-prompt", type=int, default=12)
     parser.add_argument("--max-new-tokens", type=int, default=16)
     parser.add_argument("--seed", type=int, default=0)
+    diurnal = parser.add_argument_group(
+        "diurnal", "autoscaler-vs-static-peak scenario knobs")
+    diurnal.add_argument("--period", type=float, default=4.0,
+                         help="diurnal period, seconds")
+    diurnal.add_argument("--periods", type=int, default=2)
+    diurnal.add_argument("--peak-qps", type=float, default=60.0)
+    diurnal.add_argument("--trough-qps", type=float, default=5.0)
+    diurnal.add_argument("--peak-fraction", type=float, default=0.3,
+                         help="fraction of each period at peak load")
+    diurnal.add_argument("--per-slice-rate", type=float, default=25.0,
+                         help="fleet service rate per slice, req/s")
+    diurnal.add_argument("--settle-seconds", type=float, default=0.15,
+                         help="world-restart pause per applied resize")
+    diurnal.add_argument("--target-depth-per-slice", type=int, default=4)
+    diurnal.add_argument("--cooldown", type=float, default=0.4,
+                         help="scaleDownCooldownSeconds for the run")
+    diurnal.add_argument("--ttft-slo", type=float, default=1.5)
+    diurnal.add_argument("--autoscale-interval", type=float, default=0.05)
     args = parser.parse_args(argv)
     try:
-        print(json.dumps(run_bench(args)))
+        if args.scenario == "diurnal":
+            print(json.dumps(run_diurnal(args)))
+        else:
+            print(json.dumps(run_bench(args)))
         return 0
     except Exception as e:  # one JSON line, even on failure
         print(json.dumps({
-            "metric": "serving_tokens_per_sec",
+            "metric": ("serving_diurnal_chip_seconds_saved"
+                       if args.scenario == "diurnal"
+                       else "serving_tokens_per_sec"),
             "value": 0.0,
-            "unit": "tokens/sec",
+            "unit": ("percent" if args.scenario == "diurnal"
+                     else "tokens/sec"),
             "error": f"{type(e).__name__}: {e}",
         }))
         return 1

@@ -13,7 +13,11 @@ pins the contract — wired into tier-1 as tests/test_flag_parity.py:
   one ``docs/*.md`` file, and that file exists and discusses the flag
   on kube;
 - no doc paragraph claims a flag is rejected / not yet supported on
-  kube unless the gate actually exists in cli.py.
+  kube unless the gate actually exists in cli.py;
+- every serving front-door flag (``--gateway-*`` / ``--autoscale-*`` /
+  ``--enable-serving-*``) is documented in docs/serving.md — the
+  gateway and autoscaler are operated from that page, so an
+  undocumented knob there is unreachable by its audience.
 
 Usage: python hack/verify-flag-parity.py   # exit 0 clean, 1 on drift
 """
@@ -41,17 +45,28 @@ _DOC_CITE = re.compile(r"docs/([a-z0-9_-]+\.md)")
 _REJECTION_WORDS = ("not yet supported", "rejects", "rejected")
 
 
-def enable_flags() -> Set[str]:
-    """Every --enable-* flag the CLI parser accepts."""
+def _parser_flags(prefixes: Tuple[str, ...]) -> Set[str]:
     sys.path.insert(0, REPO)
     from tf_operator_tpu.cli import build_parser
 
     flags: Set[str] = set()
     for action in build_parser()._actions:
         for opt in action.option_strings:
-            if opt.startswith("--enable-"):
+            if opt.startswith(prefixes):
                 flags.add(opt)
     return flags
+
+
+def enable_flags() -> Set[str]:
+    """Every --enable-* flag the CLI parser accepts."""
+    return _parser_flags(("--enable-",))
+
+
+def serving_flags() -> Set[str]:
+    """The serving front-door flag family (gateway + autoscaler): all
+    must be documented in docs/serving.md."""
+    return _parser_flags(("--gateway-", "--autoscale-",
+                          "--enable-serving-"))
 
 
 def kube_gates(path: str = CLI) -> Dict[str, Tuple[str, List[str]]]:
@@ -117,11 +132,26 @@ def check(cli_path: str = CLI, docs_dir: str = DOCS_DIR) -> List[str]:
             if not any(w in lowered for w in _REJECTION_WORDS):
                 continue
             for flag in sorted(flags - set(gates)):
-                if flag in para:
+                # Boundary match: --enable-serving must not fire on a
+                # paragraph that only names --enable-serving-autoscaler.
+                if re.search(re.escape(flag) + r"(?![a-z-])", para):
                     problems.append(
                         f"docs/{doc} claims {flag} is rejected on the kube "
                         "backend, but cli.py has no such gate (lifted "
                         "without updating the doc?)")
+
+    # Serving front-door flags must be operable from docs/serving.md.
+    serving_doc = os.path.join(docs_dir, "serving.md")
+    serving_text = ""
+    if os.path.exists(serving_doc):
+        with open(serving_doc, encoding="utf-8") as f:
+            serving_text = f.read()
+    for flag in sorted(serving_flags()):
+        if flag not in serving_text:
+            problems.append(
+                f"{flag} is a serving front-door flag but docs/serving.md "
+                "never mentions it — the gateway/autoscaler page is its "
+                "only discoverable home")
     return problems
 
 

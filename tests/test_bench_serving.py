@@ -49,6 +49,57 @@ def test_ttft_quantiles_ordered(artifact):
     assert artifact["ttft_p99_s"] >= artifact["ttft_p50_s"]
 
 
+@pytest.fixture(scope="module")
+def diurnal_artifact():
+    """One tiny diurnal run (ISSUE 18 acceptance scenario) — a single
+    short period, fast settle/cooldown, so tier-1 stays quick. The
+    headline savings number is only meaningful at the default shape
+    (benchmarks/bench_serving.py docstring); here we pin the SCHEMA and
+    the zero-drop invariant."""
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--scenario", "diurnal",
+         "--period", "0.8", "--periods", "1", "--peak-qps", "30",
+         "--trough-qps", "5", "--per-slice-rate", "25",
+         "--settle-seconds", "0.05", "--cooldown", "0.1",
+         "--autoscale-interval", "0.03", "--seed", "7"],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"exactly one JSON line, got: {proc.stdout!r}"
+    return json.loads(lines[0])
+
+
+def test_diurnal_artifact_schema(diurnal_artifact):
+    for key in ("metric", "value", "unit", "slo_s", "slo_met",
+                "autoscale", "static", "env", "config_fingerprint"):
+        assert key in diurnal_artifact, f"missing {key}"
+    assert diurnal_artifact["metric"] == "serving_diurnal_chip_seconds_saved"
+    assert diurnal_artifact["unit"] == "percent"
+    assert isinstance(diurnal_artifact["slo_met"], bool)
+    for run in ("autoscale", "static"):
+        for key in ("submitted", "completed", "rejected_429", "dropped",
+                    "chip_seconds", "slices_peak", "slices_max_seen",
+                    "ttft_p99_s", "resizes_grow", "resizes_shrink",
+                    "elapsed_s"):
+            assert key in diurnal_artifact[run], f"missing {run}.{key}"
+
+
+def test_diurnal_zero_drops_and_real_traffic(diurnal_artifact):
+    """The acceptance invariant that holds at ANY shape: nothing
+    admitted by the gateway is ever lost — every submitted request is
+    either streamed to completion or rejected up front with a 429."""
+    for run in ("autoscale", "static"):
+        r = diurnal_artifact[run]
+        assert r["dropped"] == 0
+        assert r["completed"] > 0
+        assert r["completed"] + r["rejected_429"] == r["submitted"]
+    # The static fleet holds peak size throughout; the autoscaled fleet
+    # can never exceed it.
+    auto, static = diurnal_artifact["autoscale"], diurnal_artifact["static"]
+    assert auto["slices_max_seen"] <= static["slices_peak"]
+    assert static["chip_seconds"] > 0
+
+
 def test_fingerprint_tracks_config():
     proc = subprocess.run(
         [sys.executable, BENCH, "--requests", "20", "--qps", "5000",

@@ -153,6 +153,58 @@ def test_elastic_off_by_default():
         server.shutdown()
 
 
+def test_serving_autoscaler_requires_serving_and_elastic(capsys):
+    from tf_operator_tpu.cli import main
+    with pytest.raises(SystemExit) as exc:
+        main(BASE + ["--enable-serving-autoscaler"])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert "--enable-serving" in err and "--enable-elastic" in err
+
+
+def test_serving_autoscaler_rejected_on_kube_backend(capsys):
+    """It rides the elastic resize pass, which kube does not run yet
+    (docs/serving.md): fail fast rather than silently never scaling."""
+    from tf_operator_tpu.cli import main
+    with pytest.raises(SystemExit) as exc:
+        main(BASE + ["--enable-gang-scheduling", "--enable-elastic",
+                     "--enable-serving", "--enable-serving-autoscaler",
+                     "--backend", "kube"])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert "--enable-serving-autoscaler" in err and "kube" in err
+
+
+def test_serving_gateway_needs_spool(capsys):
+    from tf_operator_tpu.cli import main
+    with pytest.raises(SystemExit) as exc:
+        main(BASE + ["--enable-serving-gateway"])
+    assert exc.value.code == 2
+    assert "--gateway-spool" in capsys.readouterr().err
+
+
+def test_serving_front_door_wires_up(tmp_path):
+    """Gateway + autoscaler assembly: the gateway fronts the given
+    spool and the autoscaler is handed the gang scheduler AND serves as
+    its resize-signal provider (the wiring docs/serving.md promises)."""
+    args = build_parser().parse_args(BASE + [
+        "--enable-gang-scheduling", "--enable-elastic",
+        "--enable-serving", "--enable-serving-autoscaler",
+        "--enable-serving-gateway", "--gateway-port", "0",
+        "--gateway-spool", str(tmp_path / "spool")])
+    server = Server(args)
+    try:
+        autoscaler = server.operator.autoscaler
+        gang = server.operator.controller.engine.gang
+        assert autoscaler is not None
+        assert autoscaler.gang is gang
+        assert gang.resize_signals == autoscaler.signals
+        assert server.gateway is not None
+        assert server.gateway.spool.root == str(tmp_path / "spool")
+    finally:
+        server.shutdown()
+
+
 def test_version_wins_over_backend_validation(capsys):
     """`--version` prints and exits even when combined with flags that
     would otherwise fail validation (e.g. --backend none w/o api-port)."""

@@ -33,7 +33,7 @@ def test_cli_and_docs_agree():
 
 def test_checker_sees_the_real_contract():
     """The gate is only as good as its parser: it must see the real
-    flag set and the one remaining kube gate (an empty parse would make
+    flag set and the remaining kube gates (an empty parse would make
     test_cli_and_docs_agree pass vacuously)."""
     mod = _load()
     flags = mod.enable_flags()
@@ -41,8 +41,11 @@ def test_checker_sees_the_real_contract():
     assert {"--enable-gang-scheduling", "--enable-tenant-queues",
             "--enable-ckpt-coordination", "--enable-serving",
             "--enable-elastic"} <= flags
-    # The node-agent relay lifted every kube gate except elastic.
-    assert set(gates) == {"--enable-elastic"}
+    # The node-agent relay lifted every kube gate except elastic — and
+    # the serving autoscaler rides the elastic resize pass, so it
+    # inherits the same gate (docs/serving.md).
+    assert set(gates) == {"--enable-elastic",
+                          "--enable-serving-autoscaler"}
     message, cited = gates["--enable-elastic"]
     assert "elastic.md" in "".join(cited)
     # The lifted flags must NOT be gated anymore.

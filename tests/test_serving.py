@@ -119,6 +119,21 @@ class TestRequestQueue:
         assert parse_tenant_weights("bad,x=2,y=zero") == {"x": 2}
         assert parse_tenant_weights("z=0") == {"z": 1}  # floored
 
+    def test_remove_tenant_prunes_gauge_series(self):
+        """Tenant-lane GC (the PR-9 job-GC cardinality rule applied to
+        tenants): removing a lane must delete its serving_queue_depth
+        series from the scrape, not leave a forever-0 ghost."""
+        q = RequestQueue(max_depth=8)
+        q.submit(Request(id="x", tenant="ghost-tenant"))
+        assert 'tenant="ghost-tenant"' in metrics.REGISTRY.render_text()
+        waiting = q.remove_tenant("ghost-tenant")
+        assert [r.id for r in waiting] == ["x"]
+        assert 'tenant="ghost-tenant"' not in metrics.REGISTRY.render_text()
+        # Re-submission after removal recreates the lane cleanly.
+        assert q.submit(Request(id="y", tenant="ghost-tenant"))
+        assert metrics.serving_queue_depth.value(tenant="ghost-tenant") == 1
+        q.remove_tenant("ghost-tenant")
+
 
 # ---------------------------------------------------------------------------
 # ContinuousBatcher + ServingEngine
@@ -257,6 +272,41 @@ class TestSpool:
         assert spool.claim_one() is None
         assert os.path.exists(os.path.join(root, "pending", "bad.json"))
 
+    def test_concurrent_claim_exactly_one_winner(self, tmp_path):
+        """Two replicas racing claim_one on REAL threads: the atomic
+        pending->claimed rename admits exactly one winner per request
+        (the single-threaded exclusivity test above can't catch a
+        read-then-rename TOCTOU; this hammers it)."""
+        import threading
+
+        root = str(tmp_path)
+        rounds = 25
+        for i in range(rounds):
+            self._write_request(root, f"r{i:03d}")
+        spools = (Spool(root, "pod-a"), Spool(root, "pod-b"))
+        wins = ([], [])
+        barrier = threading.Barrier(2)
+
+        def racer(idx):
+            barrier.wait()
+            while True:
+                got = spools[idx].claim_one()
+                if got is None:
+                    if spools[idx].pending_empty():
+                        return
+                    continue
+                wins[idx].append(got.id)
+
+        threads = [threading.Thread(target=racer, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        claimed = wins[0] + wins[1]
+        assert len(claimed) == rounds  # nothing lost...
+        assert len(set(claimed)) == rounds  # ...and nothing double-won
+
 
 # ---------------------------------------------------------------------------
 # ServingPolicy validation + ServingManager env rendering
@@ -298,10 +348,22 @@ class TestServingPolicyValidation:
                 (dict(max_queue_depth=0), "maxQueueDepth"),
                 (dict(max_tokens_per_request=0), "maxTokensPerRequest"),
                 (dict(ttft_p99_slo_seconds=0.0), "ttftP99SloSeconds"),
-                (dict(tokens_per_second_slo=-1.0), "tokensPerSecondSlo")):
+                (dict(tokens_per_second_slo=-1.0), "tokensPerSecondSlo"),
+                (dict(target_queue_depth_per_slice=0),
+                 "targetQueueDepthPerSlice"),
+                (dict(scale_down_cooldown_seconds=-1.0),
+                 "scaleDownCooldownSeconds")):
             policy = ServingPolicy(enabled=True, spool_directory="/s", **kw)
             with pytest.raises(ValidationError, match=msg):
                 validate_job(serving_job(policy=policy))
+
+    def test_zero_cooldown_is_legal(self):
+        # scaleDownCooldownSeconds=0 = no hysteresis (deterministic
+        # tests); only negatives are rejected.
+        validate_job(serving_job(policy=ServingPolicy(
+            enabled=True, spool_directory="/s",
+            target_queue_depth_per_slice=4,
+            scale_down_cooldown_seconds=0.0)))
 
     def test_disabled_policy_with_knobs_is_carried(self):
         validate_job(serving_job(policy=ServingPolicy(

@@ -447,6 +447,16 @@ class ServingPolicy(ApiObject):
                             throttles on it).
     tokens_per_second_slo:  optional per-replica decode-throughput
                             target, same artifact-only semantics.
+    target_queue_depth_per_slice: optional autoscaler setpoint
+                            (controller/autoscaler.py): desired slices =
+                            ceil(total queue depth / this), clamped to
+                            the elastic minSlices/maxSlices band. Unset
+                            = the autoscaler ignores this job.
+    scale_down_cooldown_seconds: hysteresis window for the autoscaler's
+                            shrink leg — demand must sit below the
+                            current size continuously this long before
+                            a scale-down is proposed (scale-UP is
+                            immediate; docs/serving.md).
     """
 
     enabled: bool = False
@@ -456,6 +466,8 @@ class ServingPolicy(ApiObject):
     max_tokens_per_request: int = 64
     ttft_p99_slo_seconds: Optional[float] = None
     tokens_per_second_slo: Optional[float] = None
+    target_queue_depth_per_slice: Optional[int] = None
+    scale_down_cooldown_seconds: float = 60.0
 
 
 @dataclasses.dataclass

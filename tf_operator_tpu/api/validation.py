@@ -147,6 +147,15 @@ def _spec_errors(spec: TPUJobSpec):
                 and sp.tokens_per_second_slo <= 0):
             yield ("spec.runPolicy.servingPolicy.tokensPerSecondSlo must "
                    "be > 0")
+        if (sp.target_queue_depth_per_slice is not None
+                and sp.target_queue_depth_per_slice < 1):
+            yield ("spec.runPolicy.servingPolicy.targetQueueDepthPerSlice "
+                   "must be >= 1")
+        if sp.scale_down_cooldown_seconds < 0:
+            # Zero is legal (no hysteresis — useful in tests); negative
+            # has no meaning.
+            yield ("spec.runPolicy.servingPolicy.scaleDownCooldownSeconds "
+                   "must be >= 0")
 
     if spec.queue_name and not _NAME_RE.match(spec.queue_name):
         yield (f"spec.queueName {spec.queue_name!r} must be a lowercase "

@@ -154,6 +154,42 @@ def build_parser() -> argparse.ArgumentParser:
                         "in-flight requests re-queue instead of "
                         "dropping. Off = the serving role is inert "
                         "(controller behavior identical to today)")
+    p.add_argument("--enable-serving-autoscaler", action="store_true",
+                   help="run the serving replica autoscaler (requires "
+                        "--enable-serving and --enable-elastic): elastic "
+                        "serving gangs whose servingPolicy sets "
+                        "targetQueueDepthPerSlice are resized to track "
+                        "request backlog and TTFT-SLO burn — scale-up "
+                        "immediate, scale-down after servingPolicy."
+                        "scaleDownCooldownSeconds of continuous under-"
+                        "demand (docs/serving.md). Off = serving gangs "
+                        "keep their declared numSlices")
+    p.add_argument("--autoscale-interval-seconds", type=float,
+                   default=1.0,
+                   help="seconds between serving-autoscaler policy "
+                        "passes")
+    p.add_argument("--enable-serving-gateway", action="store_true",
+                   help="serve the HTTP front door over a request spool "
+                        "in this process (serve/gateway.py; any backend "
+                        "— it only touches the spool filesystem): "
+                        "admission with per-tenant auth tokens, 429 + "
+                        "Retry-After backpressure at maxQueueDepth, "
+                        "streaming NDJSON responses (docs/serving.md). "
+                        "Also runs standalone: python -m "
+                        "tf_operator_tpu.serve.gateway")
+    p.add_argument("--gateway-port", type=int, default=8600,
+                   help="listen port for --enable-serving-gateway "
+                        "(0 = ephemeral)")
+    p.add_argument("--gateway-host", default="127.0.0.1",
+                   help="bind address for --enable-serving-gateway")
+    p.add_argument("--gateway-spool", default=None,
+                   help="request spool root the gateway fronts (the "
+                        "serving job's servingPolicy.spoolDirectory)")
+    p.add_argument("--gateway-tokens", default=None,
+                   help="'token=tenant,token=tenant' auth map for the "
+                        "gateway (default: TPUJOB_GATEWAY_TOKENS; empty "
+                        "= open gateway, every request on the 'default' "
+                        "QoS lane)")
     p.add_argument("--queue-config", default=None,
                    help="YAML/JSON file declaring clusterQueues / "
                         "tenantQueues to seed at startup (see "
@@ -321,7 +357,11 @@ class Server:
             enable_ckpt_coordination=getattr(
                 args, "enable_ckpt_coordination", False),
             enable_serving=getattr(args, "enable_serving", False),
-            enable_elastic=getattr(args, "enable_elastic", False))
+            enable_elastic=getattr(args, "enable_elastic", False),
+            enable_serving_autoscaler=getattr(
+                args, "enable_serving_autoscaler", False),
+            autoscale_interval_seconds=getattr(
+                args, "autoscale_interval_seconds", 1.0))
         if getattr(args, "backend", "local") == "kube":
             # Cluster mode: the Store is the informer cache inside
             # KubeOperator; reads/writes/leases go to the K8s API.
@@ -343,11 +383,15 @@ class Server:
                 raise RuntimeError(
                     f"CRD not installed on {client.config.server}; apply "
                     "manifests/base/crd.yaml first")
-            # Everything in tenant_kwargs except enable_elastic is
+            # Everything in tenant_kwargs except the elastic family is
             # lifted onto kube by the node-agent relay
-            # (docs/node-agent.md); elastic stays gated in main().
+            # (docs/node-agent.md); elastic — and the serving
+            # autoscaler riding its resize pass — stays gated in
+            # main().
             kube_tenant_kwargs = {k: v for k, v in tenant_kwargs.items()
-                                  if k != "enable_elastic"}
+                                  if k not in ("enable_elastic",
+                                               "enable_serving_autoscaler",
+                                               "autoscale_interval_seconds")}
             self.operator = KubeOperator(
                 client,
                 namespace=args.namespace or None,
@@ -422,6 +466,21 @@ class Server:
                 port=max(args.api_port, 0),
                 tls_cert=tls_cert, tls_key=tls_key, tokens=tokens,
                 insecure=getattr(args, "api_insecure", False))
+        self.gateway = None
+        if getattr(args, "enable_serving_gateway", False):
+            from tf_operator_tpu.serve.gateway import (
+                GatewayServer,
+                parse_token_map,
+            )
+
+            raw_tokens = getattr(args, "gateway_tokens", None)
+            if raw_tokens is None:
+                raw_tokens = os.environ.get("TPUJOB_GATEWAY_TOKENS", "")
+            self.gateway = GatewayServer(
+                args.gateway_spool,
+                port=max(getattr(args, "gateway_port", 8600), 0),
+                host=getattr(args, "gateway_host", "127.0.0.1"),
+                tokens=parse_token_map(raw_tokens))
         self.monitoring: Optional[MonitoringServer] = None
         if args.monitoring_port != 0:
             self.monitoring = MonitoringServer(
@@ -494,6 +553,11 @@ class Server:
             log.info("control-plane API on %s", self.api_server.url)
         if self.monitoring is not None:
             self.monitoring.start()
+        if self.gateway is not None:
+            # Data-plane adapter, not a control loop: up regardless of
+            # leadership, like the API server.
+            self.gateway.start()
+            log.info("serving gateway on :%d", self.gateway.port)
         if self.elector is not None:
             self.elector.start()
         else:
@@ -506,6 +570,8 @@ class Server:
         self.operator.stop()
         if self.api_server is not None:
             self.api_server.stop()
+        if self.gateway is not None:
+            self.gateway.stop()
         if self.monitoring is not None:
             self.monitoring.stop()
 
@@ -533,6 +599,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                      "--enable-gang-scheduling: the resize pass is a "
                      "gang-scheduler pass — without gang admission "
                      "there is no slice accounting to resize against")
+    if args.enable_serving_autoscaler and not (args.enable_serving
+                                               and args.enable_elastic):
+        parser.error("--enable-serving-autoscaler requires "
+                     "--enable-serving and --enable-elastic: the "
+                     "autoscaler maps serving queue depth to elastic "
+                     "gang resizes — without both there is nothing to "
+                     "measure or to resize")
+    if args.enable_serving_gateway and not args.gateway_spool:
+        parser.error("--enable-serving-gateway needs --gateway-spool: "
+                     "the gateway is an HTTP adapter over a request "
+                     "spool (the serving job's servingPolicy."
+                     "spoolDirectory; docs/serving.md)")
     if args.enable_elastic and args.backend == "kube":
         parser.error("--enable-elastic is not yet supported with "
                      "--backend kube: a world-resize restart rewrites "
@@ -540,6 +618,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                      "does not propagate to running containers yet "
                      "(docs/elastic.md Scope); use the local or served "
                      "backend")
+    if args.enable_serving_autoscaler and args.backend == "kube":
+        parser.error("--enable-serving-autoscaler is not yet supported "
+                     "with --backend kube: it rides the elastic resize "
+                     "pass, which kube does not run yet "
+                     "(docs/elastic.md Scope, docs/serving.md); use "
+                     "the local or served backend")
     if args.backend == "kube" and args.api_port != 0:
         parser.error("--backend kube cannot serve --api-port: the Store "
                      "is a read cache of the cluster there, so jobs "

@@ -234,10 +234,10 @@ class SliceGangScheduler(GangScheduler):
         # behavior byte-identical to the pre-elastic scheduler.
         self.elastic = elastic
         # Optional resize-decision signal provider:
-        # (namespace, name) -> {signal: value}, e.g. serving_queue_depth
-        # for the future serving autoscaler (ROADMAP item 3a). The pass
-        # attaches the values to the resize record/event; it does not
-        # yet act on them.
+        # (namespace, name) -> {signal: value}, e.g. serving_queue_depth.
+        # The serving autoscaler (controller/autoscaler.py) both acts on
+        # these values and doubles as the provider, so the pass attaches
+        # the demand each resize decision saw to its record/event.
         self.resize_signals = resize_signals
         # Optional event recorder (GangResized events).
         self.recorder = recorder
@@ -760,10 +760,10 @@ class SliceGangScheduler(GangScheduler):
 
     def _signal_values(self, namespace: str, name: str) -> Dict[str, float]:
         """Resize-decision signals (e.g. serving_queue_depth) from the
-        optional provider — attached to the resize record/event so the
-        future serving autoscaler (ROADMAP item 3a) and humans reading
-        events see what the decision saw; the pass does not yet act on
-        them."""
+        optional provider — attached to the resize record/event so
+        humans reading events see what the decision saw. The serving
+        autoscaler (controller/autoscaler.py) is the provider when
+        enabled: its autoscale resizes carry their own inputs."""
         if self.resize_signals is None:
             return {}
         try:

@@ -32,7 +32,7 @@ Shares the attention stack with the Llama family.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -69,6 +69,12 @@ class MixtralConfig:
     remat: bool = True
     attention_impl: str = ""
     sp_axis: str = "sp"
+    # Incremental-decode mode (the serving plane): the shared attention
+    # stack reads/writes its causal KV cache exactly as in the Llama
+    # family (LlamaConfig.decode) — MoE routing is stateless per token,
+    # so decode only changes the attention branch. Param tree unchanged;
+    # trained checkpoints load into the decode model as-is.
+    decode: bool = False
 
     def attention_config(self) -> LlamaConfig:
         return LlamaConfig(
@@ -77,7 +83,8 @@ class MixtralConfig:
             n_kv_heads=self.n_kv_heads, head_dim=self.head_dim,
             mlp_dim=self.mlp_dim, max_seq_len=self.max_seq_len,
             rope_theta=self.rope_theta, dtype=self.dtype, remat=self.remat,
-            attention_impl=self.attention_impl, sp_axis=self.sp_axis)
+            attention_impl=self.attention_impl, sp_axis=self.sp_axis,
+            decode=self.decode)
 
 
 def mixtral_8x7b() -> MixtralConfig:
@@ -134,6 +141,15 @@ class MoELayer(nn.Module):
         e = cfg.n_experts
         k = cfg.experts_per_token
         capacity = max(k, int(t * k * cfg.capacity_factor / e))
+        if cfg.decode:
+            # Inference never drops assignments: capacity dropping is a
+            # training throughput/HBM trade, and it makes routing depend
+            # on the rest of the batch — incremental decode could never
+            # reproduce a full forward. At capacity = T*K no expert
+            # buffer can overflow, so routing is per-token dense and
+            # decode is exactly reproducible against a drop-free
+            # reference (capacity_factor >= n_experts).
+            capacity = t * k
 
         xt = x.reshape(t, h)
         router_logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
@@ -279,11 +295,12 @@ class MixtralBlock(nn.Module):
     config: MixtralConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, angles: jax.Array
+    def __call__(self, x: jax.Array, angles: jax.Array,
+                 positions: Optional[jax.Array] = None
                  ) -> Tuple[jax.Array, jax.Array]:
         cfg = self.config
         x = x + LlamaAttention(cfg.attention_config(), name="attn")(
-            RMSNorm(name="attn_norm")(x), angles)
+            RMSNorm(name="attn_norm")(x), angles, positions)
         moe_out, aux = MoELayer(cfg, name="moe")(RMSNorm(name="mlp_norm")(x))
         return x + moe_out, aux
 
@@ -292,7 +309,9 @@ class Mixtral(nn.Module):
     config: MixtralConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    def __call__(self, tokens: jax.Array,
+                 positions: Optional[jax.Array] = None
+                 ) -> Tuple[jax.Array, jax.Array]:
         """Returns (logits, aux_loss)."""
         cfg = self.config
         x = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
@@ -301,22 +320,73 @@ class Mixtral(nn.Module):
                                   cfg.rope_theta)
 
         block = MixtralBlock
-        if cfg.remat:
+        if cfg.remat and not cfg.decode:
+            # Decode has no backward pass to trade HBM for; remat would
+            # only re-run the forward.
             block = nn.remat(block, prevent_cse=False)
+        variable_axes = {"params": 0}
+        if cfg.decode:
+            # Per-block KV caches stack on a leading layers axis, like
+            # the scanned params (llama.py decode).
+            variable_axes["cache"] = 0
         ScanBlocks = nn.scan(
             block,
-            variable_axes={"params": 0},
+            variable_axes=variable_axes,
             split_rngs={"params": True},
             in_axes=nn.broadcast,
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        x, aux = ScanBlocks(cfg, name="blocks")(x, angles)
+        if positions is None:
+            x, aux = ScanBlocks(cfg, name="blocks")(x, angles)
+        else:
+            x, aux = ScanBlocks(cfg, name="blocks")(x, angles, positions)
 
         x = RMSNorm(name="final_norm")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=jnp.float32, name="lm_head")(x)
         return logits, jnp.mean(aux)
+
+
+# ---------------------------------------------------------------------------
+# Incremental decode (serving plane). Same contract as the Llama helpers
+# (llama.py init_cache/prefill/decode_step/insert_cache) — the KV cache
+# is an explicit pytree owned by the caller — except every forward
+# returns (logits, aux); the helpers drop the aux loss (it only matters
+# for training). insert_cache is the generic tree-map slot write and is
+# re-exported from llama.py unchanged.
+# ---------------------------------------------------------------------------
+
+from tf_operator_tpu.models.llama import insert_cache  # noqa: E402,F401
+
+
+def init_cache(model: "Mixtral", params, batch_size: int):
+    """All-zeros KV cache pytree for ``batch_size`` concurrent slots
+    (built from ``eval_shape``; see llama.init_cache)."""
+    tokens = jnp.zeros((batch_size, 1), jnp.int32)
+    positions = jnp.zeros((batch_size, 1), jnp.int32)
+    _, variables = jax.eval_shape(
+        lambda p, t, pos: model.apply({"params": p}, t, positions=pos,
+                                      mutable=["cache"]),
+        params, tokens, positions)
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                        variables["cache"])
+
+
+def prefill(model: "Mixtral", params, cache, tokens: jax.Array,
+            positions: jax.Array):
+    """One incremental-decode forward: returns (logits, updated cache).
+    The MoE aux loss is discarded (inference-only path)."""
+    (logits, _aux), variables = model.apply(
+        {"params": params, "cache": cache}, tokens, positions=positions,
+        mutable=["cache"])
+    return logits, variables["cache"]
+
+
+def decode_step(model: "Mixtral", params, cache, tokens: jax.Array,
+                positions: jax.Array):
+    """One token per row: ``prefill`` at S = 1."""
+    return prefill(model, params, cache, tokens, positions)
 
 
 _MOE_LEAF_AXES = {

@@ -50,6 +50,9 @@ class Operator:
                  enable_ckpt_coordination: bool = False,
                  enable_serving: bool = False,
                  enable_elastic: bool = False,
+                 enable_serving_autoscaler: bool = False,
+                 autoscale_interval_seconds: float = 1.0,
+                 autoscale_signals=None,
                  resize_signals=None,
                  enable_slice_health: bool = False,
                  health_drain_grace_seconds: float = 0.0,
@@ -82,6 +85,12 @@ class Operator:
             raise ValueError("elastic resize is a gang-scheduler pass: "
                              "--enable-elastic requires "
                              "--enable-gang-scheduling")
+        if enable_serving_autoscaler and not (enable_serving
+                                              and enable_elastic):
+            raise ValueError("the serving autoscaler maps queue depth to "
+                             "elastic resizes: --enable-serving-autoscaler "
+                             "requires --enable-serving and "
+                             "--enable-elastic")
         if enable_ckpt_coordination:
             from tf_operator_tpu.controller.ckpt import (
                 CheckpointCoordinator,
@@ -100,6 +109,7 @@ class Operator:
             self.serving = ServingManager(self.store,
                                           recorder=self.recorder,
                                           namespace=namespace)
+        self.autoscaler = None
         if enable_gang_scheduling:
             config.enable_gang_scheduling = True
             if enable_tenant_queues:
@@ -113,6 +123,20 @@ class Operator:
                                                 recorder=self.recorder)
                 if queue_config:
                     seed_queues(self.store, *load_queue_config(queue_config))
+            if enable_serving_autoscaler:
+                from tf_operator_tpu.controller.autoscaler import (
+                    ServingAutoscaler,
+                )
+
+                # Built before the gang so it can double as the resize-
+                # signal provider: resize records/events then carry the
+                # queue-depth/TTFT values the decision saw.
+                self.autoscaler = ServingAutoscaler(
+                    self.store, None, namespace=namespace,
+                    interval_seconds=autoscale_interval_seconds,
+                    signals=autoscale_signals)
+                if resize_signals is None:
+                    resize_signals = self.autoscaler.signals
             gang = SliceGangScheduler(self.store, total_chips=total_chips,
                                       fairness=gang_fairness,
                                       aging_seconds=gang_aging_seconds,
@@ -125,6 +149,8 @@ class Operator:
                                       elastic=enable_elastic,
                                       resize_signals=resize_signals,
                                       recorder=self.recorder)
+            if self.autoscaler is not None:
+                self.autoscaler.gang = gang
         self.controller = TPUJobController(self.store, recorder=self.recorder,
                                            config=config, gang=gang,
                                            namespace=namespace,
@@ -164,6 +190,8 @@ class Operator:
         self.controller.run(threadiness=threadiness)
         if self.health is not None:
             self.health.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         log.info("operator started (threadiness=%d)", threadiness)
 
     def _persist_event(self, ev) -> None:
@@ -192,6 +220,8 @@ class Operator:
             log.debug("event persist failed", exc_info=True)
 
     def stop(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if self.health is not None:
             self.health.stop()
         self.controller.stop()

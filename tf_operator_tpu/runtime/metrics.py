@@ -426,7 +426,8 @@ job_goodput_ratio = REGISTRY.gauge(
 gang_resizes = REGISTRY.counter(
     "tpu_operator_gang_resizes_total",
     "Elastic gang resizes applied by the control plane, by direction "
-    "(grow|shrink) and reason (idle|reclaim|drain|manual|chaos)",
+    "(grow|shrink) and reason (idle|reclaim|drain|manual|chaos|"
+    "autoscale)",
     ["direction", "reason"])
 job_slices = REGISTRY.gauge(
     "tpu_operator_job_slices",
@@ -509,3 +510,28 @@ serving_requests_total = REGISTRY.counter(
     "Serving requests by terminal outcome: completed (response "
     "emitted), rejected (queue full at submit), requeued (drained "
     "mid-flight back to the spool for another replica)", ["outcome"])
+gateway_requests = REGISTRY.counter(
+    "tpu_operator_gateway_requests_total",
+    "HTTP requests the serving gateway answered, by status code (200 "
+    "accepted+streamed, 400 malformed, 401 unknown auth token, 429 "
+    "spool backlog at maxQueueDepth — carries Retry-After)", ["code"])
+gateway_streaming_seconds = REGISTRY.histogram(
+    "tpu_operator_gateway_streaming_seconds",
+    "Accepted gateway request admission to last streamed token (the "
+    "full-response latency the TTFT histogram is the head of)",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0, 60.0))
+
+# --- serving replica autoscaler (controller/autoscaler.py;
+# docs/serving.md autoscaler section).
+autoscaler_target_slices = REGISTRY.gauge(
+    "tpu_operator_autoscaler_target_slices",
+    "The autoscaler's most recent numSlices target for a serving gang "
+    "(post-clamp to minSlices/maxSlices; compare with job_slices to "
+    "see convergence)", ["job_namespace", "job"])
+autoscaler_holds = REGISTRY.counter(
+    "tpu_operator_autoscaler_holds_total",
+    "Autoscaler passes that wanted a different size but held, by "
+    "reason (cooldown = shrink hysteresis window still open; settling "
+    "= a prior resize has not completed; bounds = target clamped back "
+    "to the current size)", ["reason"])

@@ -145,6 +145,19 @@ class RequestQueue:
         with self._lock:
             return list(self._lanes)
 
+    def remove_tenant(self, tenant: str) -> List[Request]:
+        """Drop a tenant's lane — called when its TenantQueue is deleted
+        — returning any requests still waiting so the caller can
+        re-spool or fail them. Also removes the lane's
+        ``serving_queue_depth{tenant=...}`` gauge series: a deleted
+        tenant must not leak a stale 0-valued series forever (the PR-9
+        job-GC cardinality rule applied to serving)."""
+        with self._lock:
+            lane = self._lanes.pop(tenant, None)
+            self._credits.pop(tenant, None)
+            metrics.serving_queue_depth.remove(tenant=tenant)
+            return list(lane or ())
+
     # -- internals -------------------------------------------------------
 
     def _lane(self, tenant: str) -> Deque[Request]:

@@ -1,7 +1,8 @@
-"""LlamaRunner: the continuous batcher's prefill/decode phases on the
-real model (models/llama.py incremental-decode path).
+"""Model runners: the continuous batcher's prefill/decode phases on the
+real models (models/llama.py and models/mixtral.py incremental-decode
+paths, which share one cache contract).
 
-Phase split and compile behavior:
+Phase split and compile behavior (common to both families):
 
 - ``prefill`` runs one request at a time on a single-row cache, padded
   to a power-of-two bucket so the number of distinct XLA programs is
@@ -13,9 +14,11 @@ Phase split and compile behavior:
   decode garbage rows that are overwritten before any real sequence can
   attend them (see LlamaAttention._cached_attention).
 
-Run it under ``parallel.mesh.use_mesh`` to shard: the cache constrains
+Run them under ``parallel.mesh.use_mesh`` to shard: the cache constrains
 itself to the mesh via the kv_heads/kv_seq logical axes, so tp splits
-cache heads exactly like the attention weights.
+cache heads exactly like the attention weights. The Mixtral runner's
+MoE routing is drop-free under decode (MixtralConfig.decode), so its
+output is token-identical to a drop-free full-model greedy reference.
 """
 
 from __future__ import annotations
@@ -26,26 +29,23 @@ from typing import List, Optional
 from tf_operator_tpu.serve.batcher import Runner
 
 
-class LlamaRunner(Runner):
-    def __init__(self, config=None, params=None, max_slots: int = 4,
-                 rng_seed: int = 0, eos: Optional[int] = None,
-                 min_prefill_bucket: int = 8):
+class _CachedDecodeRunner(Runner):
+    """Shared machinery over the incremental-decode helper contract
+    (init_cache/prefill/decode_step/insert_cache + a decode=True
+    config). Subclasses bind the model family in ``__init__`` —
+    imports stay inside it so slim installs only pay for the family
+    they ask for (serve/worker.py build_runner)."""
+
+    def _setup(self, model, config, params, helpers, max_slots: int,
+               rng_seed: int, eos: Optional[int],
+               min_prefill_bucket: int) -> None:
         import jax
         import jax.numpy as jnp
 
-        from tf_operator_tpu.models.llama import (
-            Llama,
-            decode_step,
-            init_cache,
-            insert_cache,
-            llama_tiny,
-            prefill,
-        )
-
+        init_cache, prefill, decode_step, insert_cache = helpers
         self._jnp = jnp
-        cfg = config or llama_tiny()
-        self.config = dataclasses.replace(cfg, decode=True)
-        self.model = Llama(self.config)
+        self.config = config
+        self.model = model
         self.max_slots = max_slots
         self.eos = eos
         self.min_prefill_bucket = min_prefill_bucket
@@ -59,7 +59,6 @@ class LlamaRunner(Runner):
         # past the new prompt's length are never attended before being
         # overwritten, so no zeroing between requests.
         self._stage = init_cache(self.model, params, 1)
-        model = self.model
         self._prefill_fn = jax.jit(
             lambda p, c, t, pos: prefill(model, p, c, t, pos))
         self._decode_fn = jax.jit(
@@ -110,3 +109,46 @@ class LlamaRunner(Runner):
         for slot in active:
             out[slot] = int(best[slot])
         return out
+
+
+class LlamaRunner(_CachedDecodeRunner):
+    def __init__(self, config=None, params=None, max_slots: int = 4,
+                 rng_seed: int = 0, eos: Optional[int] = None,
+                 min_prefill_bucket: int = 8):
+        from tf_operator_tpu.models.llama import (
+            Llama,
+            decode_step,
+            init_cache,
+            insert_cache,
+            llama_tiny,
+            prefill,
+        )
+
+        cfg = dataclasses.replace(config or llama_tiny(), decode=True)
+        self._setup(Llama(cfg), cfg, params,
+                    (init_cache, prefill, decode_step, insert_cache),
+                    max_slots, rng_seed, eos, min_prefill_bucket)
+
+
+class MixtralRunner(_CachedDecodeRunner):
+    """MoE serving: decode-mode routing is drop-free (every token
+    reaches its top-k experts — MixtralConfig.decode), so generation is
+    deterministic per token and reproducible against a drop-free
+    full-model reference (capacity_factor >= n_experts)."""
+
+    def __init__(self, config=None, params=None, max_slots: int = 4,
+                 rng_seed: int = 0, eos: Optional[int] = None,
+                 min_prefill_bucket: int = 8):
+        from tf_operator_tpu.models.mixtral import (
+            Mixtral,
+            decode_step,
+            init_cache,
+            insert_cache,
+            mixtral_tiny,
+            prefill,
+        )
+
+        cfg = dataclasses.replace(config or mixtral_tiny(), decode=True)
+        self._setup(Mixtral(cfg), cfg, params,
+                    (init_cache, prefill, decode_step, insert_cache),
+                    max_slots, rng_seed, eos, min_prefill_bucket)

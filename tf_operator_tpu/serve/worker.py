@@ -170,24 +170,55 @@ def _publish_record(path: str, completed: int, barrier: str,
         pass
 
 
-def build_runner(kind: str, slots: int):
-    if kind == "fake":
-        return FakeRunner(max_slots=slots)
-    if kind == "llama":
-        from tf_operator_tpu.serve.runner import LlamaRunner
+def _fake_runner(slots: int):
+    return FakeRunner(max_slots=slots)
 
-        return LlamaRunner(max_slots=slots)
-    raise ValueError(f"unknown runner {kind!r}; expected fake|llama")
+
+def _llama_runner(slots: int):
+    from tf_operator_tpu.serve.runner import LlamaRunner
+
+    return LlamaRunner(max_slots=slots)
+
+
+def _mixtral_runner(slots: int):
+    from tf_operator_tpu.serve.runner import MixtralRunner
+
+    return MixtralRunner(max_slots=slots)
+
+
+# Runner registry: factories import their model deps lazily (the
+# tlsutil pattern), so the slim install — no jax — runs the fake
+# runner and only a real-model request pays the import (or fails with
+# an actionable hint instead of a bare ImportError at module load).
+RUNNERS = {
+    "fake": _fake_runner,
+    "llama": _llama_runner,
+    "mixtral": _mixtral_runner,
+}
+
+
+def build_runner(kind: str, slots: int):
+    factory = RUNNERS.get(kind)
+    if factory is None:
+        raise ValueError(f"unknown runner {kind!r}; expected "
+                         + "|".join(sorted(RUNNERS)))
+    try:
+        return factory(slots)
+    except ImportError as e:
+        raise RuntimeError(
+            f"runner {kind!r} needs the model stack; install the "
+            "compute extra (pip install tf-operator-tpu[compute]) or "
+            "use --runner fake on slim installs") from e
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--runner", default="fake",
-                        choices=("fake", "llama"),
+                        choices=tuple(sorted(RUNNERS)),
                         help="decode backend: 'fake' = deterministic "
                              "jax-free generator (hermetic e2e); "
-                             "'llama' = the real incremental-decode "
-                             "path (models/llama.py)")
+                             "'llama' / 'mixtral' = the real "
+                             "incremental-decode paths (models/)")
     parser.add_argument("--poll-interval", type=float, default=0.02)
     parser.add_argument("--spool", default=None,
                         help="override TPUJOB_SERVE_SPOOL")
