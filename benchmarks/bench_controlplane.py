@@ -296,6 +296,544 @@ def run_bench(jobs: int, workers: int, threadiness: int,
     return result
 
 
+class _OwnershipRecorder:
+    """_SyncTimer variant for the sharded scenario: times syncs AND
+    records the ownership evidence — every synced key must hash to the
+    syncing controller's shard, and no shard may have two live
+    controllers (the no-double-reconcile proof)."""
+
+    def __init__(self, controller: TPUJobController, store: Store,
+                 shards: int, durations: List[float],
+                 violations: List[str], lock: threading.Lock):
+        from tf_operator_tpu.runtime.leaderelection import shard_for
+
+        self._inner = controller.sync_tpujob
+        self._controller = controller
+        self._store = store
+        self._shards = shards
+        self._shard_for = shard_for
+        self.durations = durations
+        self.violations = violations
+        self._lock = lock
+        controller.sync_tpujob = self  # type: ignore[assignment]
+
+    def __call__(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        snap = self._store.get_snapshot(store_mod.TPUJOBS, ns, name)
+        if snap is not None:
+            owner = self._shard_for(ns, snap.metadata.uid, self._shards)
+            if owner != self._controller.shard_index:
+                with self._lock:
+                    self.violations.append(
+                        f"{key} synced by shard "
+                        f"{self._controller.shard_index}, owned by "
+                        f"shard {owner}")
+        t0 = time.perf_counter()
+        try:
+            self._inner(key)
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.durations.append(dt)
+
+
+class _ShardedReplica:
+    """One operator replica of the sharded scenario: a ShardMap whose
+    acquisitions build a per-shard TPUJobController resuming from the
+    store's watch log (since_rv) plus one resync sweep of the shard's
+    jobs — the takeover fast path. A global ``active`` registry proves
+    single-ownership: two live controllers on one shard is a recorded
+    violation."""
+
+    def __init__(self, name: str, store: Store, shards: int,
+                 threadiness: int, durations: List[float],
+                 violations: List[str], lock: threading.Lock,
+                 active: Dict[int, str],
+                 lease_duration: float = 1.0,
+                 renew_deadline: float = 0.4,
+                 retry_period: float = 0.05,
+                 controller_store=None,
+                 expectations_timeout: Optional[float] = None):
+        from tf_operator_tpu.runtime.leaderelection import (
+            ShardMap,
+            shard_for,
+        )
+
+        self.name = name
+        self.store = store
+        # Chaos rounds reconcile through a fault-injecting store while
+        # the shard leases stay on the healthy base (a flaky lease is a
+        # different failure mode than a flaky API).
+        self.controller_store = controller_store or store
+        self.expectations_timeout = expectations_timeout
+        self.shards = shards
+        self.threadiness = threadiness
+        self.durations = durations
+        self.violations = violations
+        self.lock = lock
+        self.active = active
+        self._shard_for = shard_for
+        self.controllers: Dict[int, TPUJobController] = {}
+        self.map = ShardMap(store, shards, identity=name,
+                            namespace=NAMESPACE,
+                            lease_duration=lease_duration,
+                            renew_deadline=renew_deadline,
+                            retry_period=retry_period,
+                            on_shard_acquired=self._acquired,
+                            on_shard_lost=self._lost)
+
+    def _acquired(self, index: int) -> None:
+        with self.lock:
+            holder = self.active.get(index)
+            if holder is not None:
+                self.violations.append(
+                    f"shard {index} acquired by {self.name} while "
+                    f"{holder} still runs a controller on it "
+                    "(double-reconcile window)")
+            self.active[index] = self.name
+        since_rv = self.store.latest_rv()
+        c = TPUJobController(self.controller_store, namespace=NAMESPACE,
+                             shard_index=index, shard_count=self.shards)
+        if self.expectations_timeout is not None:
+            c.expectations._timeout = self.expectations_timeout
+        _OwnershipRecorder(c, self.store, self.shards, self.durations,
+                           self.violations, self.lock)
+        c.run(threadiness=self.threadiness, since_rv=since_rv)
+        # Resume covers events AFTER since_rv; one sweep of the shard's
+        # current jobs covers everything before it (snapshot walk, no
+        # deepcopies).
+        for ns, name, _ in self.store.keys(store_mod.TPUJOBS):
+            snap = self.store.get_snapshot(store_mod.TPUJOBS, ns, name)
+            if (snap is not None
+                    and self._shard_for(ns, snap.metadata.uid,
+                                        self.shards) == index):
+                c.enqueue(f"{ns}/{name}")
+        self.controllers[index] = c
+
+    def _lost(self, index: int) -> None:
+        c = self.controllers.pop(index, None)
+        with self.lock:
+            if self.active.get(index) == self.name:
+                del self.active[index]
+        if c is not None:
+            c.stop()
+
+    def crash_shard(self, index: int) -> None:
+        """Kill this replica's hold on ``index`` the hard way: elector
+        dies renewing nothing (no release — survivors must wait out the
+        lease), controller dies with its workqueue/expectations."""
+        from tf_operator_tpu.runtime.chaos import crash_controller
+
+        self.map.crash(index)
+        c = self.controllers.pop(index, None)
+        with self.lock:
+            if self.active.get(index) == self.name:
+                del self.active[index]
+        crash_controller(c)
+
+    def stop(self) -> None:
+        self.map.stop()
+        for index in list(self.controllers):
+            self._lost(index)
+
+
+def run_sharded_bench(jobs: int, workers: int, shards: int,
+                      threadiness: int, timeout: float,
+                      kubelet_tick: float = 0.01,
+                      kill_shard: bool = True,
+                      trace: bool = True,
+                      lease_duration: Optional[float] = None,
+                      renew_deadline: Optional[float] = None,
+                      retry_period: Optional[float] = None) -> Dict:
+    """Sharded control-plane scenario (--shards N): the run_bench fleet
+    shape against N shard leases. Replica A contends for every shard
+    and wins them all; standby replica B contends too and initially
+    holds nothing. Each held shard runs a full TPUJobController over
+    only its jobs (ownership hash on (namespace, uid)).
+
+    ``kill_shard`` injects the failover: once a third of the fleet has
+    converged, one of A's shards is crashed (lease NOT released,
+    controller killed abruptly) — B re-acquires it after lease expiry
+    and drives the shard's remaining jobs home. The artifact records
+    the availability cost (failover_seconds) and the correctness
+    evidence (ownership_violations must be empty: every sync on the
+    owning shard, never two live controllers per shard).
+
+    The FakeKubelet data plane, job shape, and deepcopy accounting are
+    identical to run_bench, so the jobs/sec ratio is apples-to-apples.
+    """
+    from tf_operator_tpu.runtime.leaderelection import shard_for
+
+    store = Store()
+    copies = _DeepcopyCounter()
+    kubelet = FakeKubelet(store, tick=kubelet_tick)
+    durations: List[float] = []
+    violations: List[str] = []
+    lock = threading.Lock()
+    active: Dict[int, str] = {}
+    per_shard_threads = max(1, threadiness // shards)
+    # Bench-proportionate lease timings. Small fleets get fast leases
+    # so failover is cheap to measure; at the 2kx32 shape the watch
+    # fan-out + sync load starves elector threads for whole-second
+    # stretches, and a 0.4s renew deadline reads that scheduling jitter
+    # as leader death — spurious stepdowns whose takeovers land before
+    # the loser's teardown, i.e. manufactured split-brain. Production
+    # uses 15/5/3 for the same reason.
+    if lease_duration is None:
+        big = jobs * workers >= 20_000
+        lease_duration = 10.0 if big else 1.0
+        renew_deadline = 5.0 if big else 0.4
+        retry_period = 0.5 if big else 0.05
+
+    if trace:
+        trace_mod.RECORDER.reset()
+        trace_mod.configure(True)
+
+    hits0 = store.watch_cache_hits
+    misses0 = store.watch_cache_misses
+    replica_a = _ShardedReplica("replica-a", store, shards,
+                                per_shard_threads, durations, violations,
+                                lock, active,
+                                lease_duration=lease_duration,
+                                renew_deadline=renew_deadline,
+                                retry_period=retry_period)
+    replica_b = _ShardedReplica("replica-b", store, shards,
+                                per_shard_threads, durations, violations,
+                                lock, active,
+                                lease_duration=lease_duration,
+                                renew_deadline=renew_deadline,
+                                retry_period=retry_period)
+    replica_a.map.start()
+    if not replica_a.map.wait_until_held(shards, timeout=30.0):
+        raise TimeoutError(
+            f"replica A holds {sorted(replica_a.map.held())} of "
+            f"{shards} shards after 30s")
+    replica_b.map.start()  # standby: contends, acquires nothing yet
+
+    kubelet.start()
+    t0 = time.perf_counter()
+    killed_shard: Optional[int] = None
+    kill_t: Optional[float] = None
+    failover_seconds: Optional[float] = None
+    try:
+        for i in range(jobs):
+            job = testutil.new_tpujob(worker=workers,
+                                      name=f"bench-{i:04d}",
+                                      namespace=NAMESPACE)
+            store.create(store_mod.TPUJOBS, job)
+
+        deadline = t0 + timeout
+        while True:
+            succeeded = sum(store.project(
+                store_mod.TPUJOBS,
+                lambda j: 1 if cond.is_succeeded(j.status) else None,
+                namespace=NAMESPACE))
+            if (kill_shard and killed_shard is None
+                    and succeeded >= max(1, jobs // 3)):
+                killed_shard = shards - 1
+                kill_t = time.perf_counter()
+                replica_a.crash_shard(killed_shard)
+            if (killed_shard is not None and failover_seconds is None
+                    and killed_shard in replica_b.map.held()):
+                failover_seconds = time.perf_counter() - kill_t
+            if succeeded >= jobs:
+                break
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"{succeeded}/{jobs} jobs Succeeded after {timeout}s "
+                    f"(A holds {sorted(replica_a.map.held())}, "
+                    f"B holds {sorted(replica_b.map.held())})")
+            time.sleep(0.02)
+        convergence = time.perf_counter() - t0
+        if kill_shard and killed_shard is not None and failover_seconds is None:
+            # Small fleets can converge before the standby has even
+            # waited out the dead leader's lease — that is a fast
+            # bench, not a failover bug. Give B the worst-case
+            # acquisition window (lease expiry + jittered retries)
+            # before declaring the shard orphaned.
+            acquire_by = time.perf_counter() + 3 * lease_duration + 2.0
+            while time.perf_counter() < acquire_by:
+                if killed_shard in replica_b.map.held():
+                    failover_seconds = time.perf_counter() - kill_t
+                    break
+                time.sleep(retry_period or 0.05)
+    finally:
+        kubelet.stop()
+        replica_a.stop()
+        replica_b.stop()
+        store.stop_watchers()
+        n_copies = copies.stop()
+        if trace:
+            trace_mod.configure(False)
+
+    if kill_shard and killed_shard is not None and failover_seconds is None:
+        violations.append(
+            f"killed shard {killed_shard} never re-acquired by the "
+            "standby replica")
+
+    owned = {i: 0 for i in range(shards)}
+    for ns, name, _ in store.keys(store_mod.TPUJOBS):
+        snap = store.get_snapshot(store_mod.TPUJOBS, ns, name)
+        if snap is not None:
+            owned[shard_for(ns, snap.metadata.uid, shards)] += 1
+    hits = store.watch_cache_hits - hits0
+    misses = store.watch_cache_misses - misses0
+    reassignments = replica_a.map.reassignments + replica_b.map.reassignments
+
+    durations_snap = list(durations)
+    syncs = len(durations_snap)
+    result = {
+        "convergence_seconds": round(convergence, 3),
+        "jobs_per_sec": round(jobs / convergence, 2),
+        "syncs": syncs,
+        "syncs_per_sec": round(syncs / convergence, 1),
+        "reconcile_p50_ms": round(
+            _percentile(durations_snap, 0.50) * 1e3, 3),
+        "reconcile_p99_ms": round(
+            _percentile(durations_snap, 0.99) * 1e3, 3),
+        "deepcopies_per_sync": round(n_copies / max(1, syncs), 1),
+        "jobs": jobs,
+        "workers_per_job": workers,
+        "pods": jobs * workers,
+        "threadiness": threadiness,
+        "shards": shards,
+        "threadiness_per_shard": per_shard_threads,
+        "per_shard_jobs_per_sec": {
+            str(i): round(owned[i] / convergence, 2)
+            for i in range(shards)},
+        "shard_reassignments": reassignments,
+        "watch_cache_hit_rate": (
+            round(hits / (hits + misses), 3) if hits + misses else None),
+        "shard_kill": {
+            "enabled": bool(kill_shard),
+            "killed_shard": killed_shard,
+            "failover_seconds": (round(failover_seconds, 3)
+                                 if failover_seconds is not None
+                                 else None),
+        },
+        "ownership_violations": list(violations),
+        "tracing": trace,
+    }
+    if trace:
+        result["phase_attribution"] = _phase_attribution(
+            trace_mod.RECORDER.phase_totals(), convergence)
+    return result
+
+
+def run_sharded_chaos_bench(jobs: int, workers: int, shards: int,
+                            threadiness: int, timeout: float,
+                            profile_name: str = "default", seed: int = 0,
+                            profile=None, kubelet_tick: float = 0.01,
+                            crashes: int = 1,
+                            resync_period: float = 0.25) -> Dict:
+    """Split-brain chaos scenario for the sharded control plane
+    (hack/verify-chaos-invariants.py --sharded): two replicas contend
+    for N shard leases on the HEALTHY base store while every
+    controller reconciles through a seeded ``FaultProfile`` (write/read
+    5xx, 409s, timeouts, stale reads, dropped watch events). Mid-run,
+    ``crashes`` shard holders are killed the hard way — elector dead
+    without releasing the lease, controller state gone — so the
+    survivor must wait out the lease and take over through the faults.
+
+    Correctness bar, recorded in the artifact:
+      * ``ownership_violations`` empty — every sync ran on the shard
+        that owns the job's (namespace, uid) hash, and no shard ever
+        had two live controllers (the no-double-reconcile proof).
+      * ``invariant_violations`` empty — every crashed shard was
+        re-acquired, no orphaned pods, no duplicate live pod
+        identities, and the fleet converged.
+    Availability cost (failover gaps) is allowed; correctness loss is
+    not."""
+    from tf_operator_tpu.runtime.chaos import ChaosStore, FaultProfile
+    from tf_operator_tpu.runtime.leaderelection import shard_for
+
+    base = Store()
+    if profile is None:
+        profile = FaultProfile.named(profile_name, seed=seed)
+    chaos = ChaosStore(base, profile)
+    kubelet = FakeKubelet(base, tick=kubelet_tick)
+    durations: List[float] = []
+    ownership_violations: List[str] = []
+    violations: List[str] = []
+    lock = threading.Lock()
+    active: Dict[int, str] = {}
+    per_shard_threads = max(1, threadiness // shards)
+    # Leases live on the healthy base store (a flaky lease CAS is a
+    # different failure mode than a flaky API server); the controllers
+    # reconcile through the fault injector, with the chaos-bench
+    # watchdog pacing so dropped watches unblock in seconds.
+    replica_a = _ShardedReplica("replica-a", base, shards,
+                                per_shard_threads, durations,
+                                ownership_violations, lock, active,
+                                controller_store=chaos,
+                                expectations_timeout=2.0)
+    replica_b = _ShardedReplica("replica-b", base, shards,
+                                per_shard_threads, durations,
+                                ownership_violations, lock, active,
+                                controller_store=chaos,
+                                expectations_timeout=2.0)
+    replica_a.map.start()
+    if not replica_a.map.wait_until_held(shards, timeout=30.0):
+        raise TimeoutError(
+            f"replica A holds {sorted(replica_a.map.held())} of "
+            f"{shards} shards after 30s")
+    replica_b.map.start()
+
+    stop_aux = threading.Event()
+
+    def resync() -> None:
+        """Production resync backstop, shard-routed: every job is
+        re-enqueued on whichever live controller owns its hash — the
+        recovery path for dropped watch events."""
+        while not stop_aux.wait(resync_period):
+            owners: Dict[int, TPUJobController] = {}
+            for rep in (replica_a, replica_b):
+                for idx, c in list(rep.controllers.items()):
+                    owners[idx] = c
+            try:
+                for ns, name, _ in base.keys(store_mod.TPUJOBS):
+                    snap = base.get_snapshot(store_mod.TPUJOBS, ns, name)
+                    if snap is None:
+                        continue
+                    c = owners.get(
+                        shard_for(ns, snap.metadata.uid, shards))
+                    if c is not None:
+                        c.enqueue(f"{ns}/{name}")
+            except Exception:
+                pass  # racing a takeover; next period retries
+
+    kubelet.start()
+    resync_t = threading.Thread(target=resync, daemon=True,
+                                name="shard-resync")
+    t0 = time.perf_counter()
+    # (victim replica name, shard index, crash wall time)
+    crashed: List[tuple] = []
+    failovers: List[float] = []
+    try:
+        for i in range(jobs):
+            job = testutil.new_tpujob(worker=workers,
+                                      name=f"bench-{i:04d}",
+                                      namespace=NAMESPACE)
+            base.create(store_mod.TPUJOBS, job)
+        resync_t.start()
+
+        deadline = t0 + timeout
+        next_kill_at = max(1, jobs // 3)
+        while True:
+            succeeded = sum(base.project(
+                store_mod.TPUJOBS,
+                lambda j: 1 if cond.is_succeeded(j.status) else None,
+                namespace=NAMESPACE))
+            if len(crashed) < crashes and succeeded >= next_kill_at:
+                # Kill whoever currently holds the target shard —
+                # after the first failover that can be either replica.
+                target = (shards - 1 - len(crashed)) % shards
+                victim = next(
+                    (r for r in (replica_a, replica_b)
+                     if target in r.map.held()), None)
+                if victim is not None:
+                    victim.crash_shard(target)
+                    crashed.append(
+                        (victim.name, target, time.perf_counter()))
+                    next_kill_at = succeeded + max(1, jobs // 4)
+            for vname, shard, tk in crashed[len(failovers):]:
+                survivor = (replica_b if vname == "replica-a"
+                            else replica_a)
+                if shard in survivor.map.held():
+                    failovers.append(time.perf_counter() - tk)
+                else:
+                    break
+            if succeeded >= jobs:
+                break
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"{succeeded}/{jobs} jobs Succeeded after "
+                    f"{timeout}s (A holds "
+                    f"{sorted(replica_a.map.held())}, B holds "
+                    f"{sorted(replica_b.map.held())}, "
+                    f"{len(crashed)} shard crash(es))")
+            time.sleep(0.02)
+        convergence = time.perf_counter() - t0
+        if len(failovers) < len(crashed):
+            # A small fleet can converge before the survivor has waited
+            # out the dead leader's lease (1.0s here, and a loaded CI
+            # host starves the elector threads well past that) — give
+            # each pending takeover the worst-case acquisition window
+            # before calling the shard orphaned.
+            acquire_by = time.perf_counter() + 3 * 1.0 + 2.0
+            while (len(failovers) < len(crashed)
+                   and time.perf_counter() < acquire_by):
+                for vname, shard, tk in crashed[len(failovers):]:
+                    survivor = (replica_b if vname == "replica-a"
+                                else replica_a)
+                    if shard in survivor.map.held():
+                        failovers.append(time.perf_counter() - tk)
+                    else:
+                        break
+                time.sleep(0.05)
+    finally:
+        stop_aux.set()
+        kubelet.stop()
+        replica_a.stop()
+        replica_b.stop()
+        base.stop_watchers()
+
+    for vname, shard, tk in crashed[len(failovers):]:
+        survivor = replica_b if vname == "replica-a" else replica_a
+        if shard in survivor.map.held():
+            failovers.append(time.perf_counter() - tk)
+        else:
+            violations.append(
+                f"shard {shard} crashed on {vname} was never "
+                "re-acquired by the surviving replica")
+
+    # ---- post-convergence invariants (on the BASE store) -------------
+    live_jobs = {j.metadata.uid: j
+                 for j in base.list(store_mod.TPUJOBS,
+                                    namespace=NAMESPACE)}
+    seen_identity: Dict[tuple, str] = {}
+    for p in base.list(store_mod.PODS, namespace=NAMESPACE):
+        ref = p.metadata.controller_ref()
+        if ref is None or ref.uid not in live_jobs:
+            violations.append(
+                f"orphaned pod {p.metadata.name}: controller owner "
+                "missing from the store")
+            continue
+        if p.status.phase in ("Succeeded", "Failed"):
+            continue
+        ident = (ref.uid,
+                 p.metadata.labels.get(constants.LABEL_REPLICA_TYPE),
+                 p.metadata.labels.get(constants.LABEL_REPLICA_INDEX))
+        if ident in seen_identity:
+            violations.append(
+                f"duplicate live pods for identity {ident}: "
+                f"{seen_identity[ident]} and {p.metadata.name}")
+        seen_identity[ident] = p.metadata.name
+
+    return {
+        "convergence_seconds": round(convergence, 3),
+        "jobs_per_sec": round(jobs / convergence, 2),
+        "syncs": len(durations),
+        "jobs": jobs,
+        "workers_per_job": workers,
+        "pods": jobs * workers,
+        "threadiness": threadiness,
+        "shards": shards,
+        "threadiness_per_shard": per_shard_threads,
+        "chaos_profile": profile_name,
+        "chaos_seed": seed,
+        "faults_injected": chaos.injector.snapshot(),
+        "faults_injected_total": chaos.injector.total,
+        "shard_crashes": [
+            {"replica": v, "shard": s} for v, s, _ in crashed],
+        "failover_seconds": [round(f, 3) for f in failovers],
+        "shard_reassignments": (replica_a.map.reassignments
+                                + replica_b.map.reassignments),
+        "ownership_violations": list(ownership_violations),
+        "invariant_violations": list(violations),
+    }
+
+
 def run_tenant_bench(tenants: int, jobs_per_tenant: int, workers: int,
                      threadiness: int, timeout: float,
                      chips_per_job: int = 4,
@@ -1598,6 +2136,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--threadiness", type=int, default=4)
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--kubelet-tick", type=float, default=0.01)
+    p.add_argument("--shards", type=int, default=0,
+                   help="N>1 switches to the sharded control-plane "
+                        "scenario: N shard leases, jobs hashed to "
+                        "shards by (namespace, uid), a standby replica "
+                        "contending, and (unless --no-kill-shard) one "
+                        "shard of the primary crashed mid-run so the "
+                        "standby re-acquires it; the artifact records "
+                        "per-shard jobs/sec, reassignments, watch-"
+                        "cache hit rate, failover seconds, and the "
+                        "ownership evidence (docs/benchmarks.md)")
+    p.add_argument("--kill-shard", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="(--shards) crash one of the primary replica's "
+                        "shards once a third of the fleet converged "
+                        "(lease not released; the standby waits out "
+                        "expiry)")
     p.add_argument("--tenants", type=int, default=0,
                    help="N>0 switches to the multi-tenant contention "
                         "scenario: N tenant queues over one cohort, "
@@ -1666,7 +2220,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     config = {"jobs": args.jobs, "workers": args.workers,
               "threadiness": args.threadiness,
               "kubelet_tick": args.kubelet_tick}
-    if args.oversubscribe > 0:
+    if args.shards > 1:
+        config.update({"shards": args.shards,
+                       "kill_shard": args.kill_shard})
+        metric = (f"controlplane_sharded_convergence_jobs_per_sec"
+                  f"[{args.jobs}x{args.workers} s{args.shards}]")
+    elif args.oversubscribe > 0:
         config.update({"oversubscribe": args.oversubscribe,
                        "work_units": args.work_units,
                        "stagger": args.stagger,
@@ -1695,7 +2254,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         metric = (f"controlplane_convergence_jobs_per_sec"
                   f"[{args.jobs}x{args.workers}]")
     try:
-        if args.oversubscribe > 0:
+        if args.shards > 1:
+            result = run_sharded_bench(
+                args.jobs, args.workers, args.shards, args.threadiness,
+                args.timeout, kubelet_tick=args.kubelet_tick,
+                kill_shard=args.kill_shard, trace=args.trace)
+        elif args.oversubscribe > 0:
             result = run_oversubscribe_bench(
                 args.oversubscribe, args.threadiness, args.timeout,
                 chips_per_slice=args.chips_per_job,
@@ -1739,9 +2303,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             "env": _environment(),
             "config_fingerprint": config_fingerprint(config),
         }))
-        if result.get("invariant_violations"):
-            # Converged, but a chaos invariant broke: the artifact
-            # carries the details; the exit code fails the run.
+        if (result.get("invariant_violations")
+                or result.get("ownership_violations")):
+            # Converged, but a chaos/ownership invariant broke: the
+            # artifact carries the details; the exit code fails the run.
             return 1
         return 0
     except Exception as e:  # one JSON line, even on failure

@@ -34,9 +34,17 @@ The harness is ``benchmarks/bench_controlplane.py run_chaos_bench`` —
 the same machinery the ``--chaos`` scenario pins at the 200x16 shape —
 so the fuzz and the benchmark can never drift apart.
 
+``--sharded`` switches to the split-brain rounds: two operator
+replicas contend for N shard leases (jobs hashed by (namespace, uid)),
+reconcile through the same fault classes, and mid-run a shard holder
+is killed WITHOUT releasing its lease; the survivor must take over
+after expiry with every sync on the owning shard, never two live
+controllers per shard, and no orphaned/duplicate pods.
+
 Usage:
     python hack/verify-chaos-invariants.py                 # 10 rounds
     python hack/verify-chaos-invariants.py --rounds 3 --seed 7
+    python hack/verify-chaos-invariants.py --sharded --rounds 3
 
 Exit status 0 = all rounds clean; 1 = a violation, with the repro seed
 on stderr. Wired into tier-1 as tests/test_chaos_invariants.py (smoke
@@ -120,6 +128,49 @@ def run_round(seed: int, timeout: float = 120.0,
     return list(result["invariant_violations"])
 
 
+def run_shard_round(seed: int, timeout: float = 120.0,
+                    verbose: bool = False) -> List[str]:
+    """One randomized SHARDED round (--sharded): two operator replicas
+    contend for a drawn number of shard leases, reconcile a drawn fleet
+    through a drawn fault profile, and mid-run a shard holder is killed
+    without releasing its lease — the split-brain window. Violations
+    returned ([] = clean):
+
+      * a job synced by a controller whose shard doesn't own its
+        (namespace, uid) hash, or two live controllers on one shard
+        (double-reconcile);
+      * a crashed shard never re-acquired by the survivor;
+      * orphaned pods / duplicate live pod identities;
+      * no convergence inside the budget.
+
+    A NEW draw stream (separate function, not a run_round flag) so the
+    historical run_round seeds stay byte-identical."""
+    rng = random.Random(seed)
+    jobs = rng.randint(4, 8)
+    workers = rng.randint(2, 3)
+    shards = rng.choice((2, 3, 4))
+    crashes = rng.randint(1, 2)
+    profile = random_profile(rng, seed)
+    threadiness = rng.choice((2, 4))
+    try:
+        result = bench_controlplane.run_sharded_chaos_bench(
+            jobs=jobs, workers=workers, shards=shards,
+            threadiness=threadiness, timeout=timeout, seed=seed,
+            profile=profile, crashes=crashes, resync_period=0.25)
+    except TimeoutError as e:
+        return [f"no convergence under profile seed {seed} "
+                f"(sharded): {e}"]
+    if verbose:
+        print(f"  seed {seed}: {jobs}x{workers} s{shards} "
+              f"crashes={len(result['shard_crashes'])} "
+              f"faults={result['faults_injected_total']} "
+              f"failovers={result['failover_seconds']} "
+              f"converged {result['convergence_seconds']}s",
+              file=sys.stderr)
+    return (list(result["ownership_violations"])
+            + list(result["invariant_violations"]))
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     p.add_argument("--rounds", type=int, default=10)
@@ -127,25 +178,38 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="base seed (default: random; printed for repro)")
     p.add_argument("--timeout", type=float, default=120.0,
                    help="per-round convergence budget in seconds")
+    p.add_argument("--sharded", action="store_true",
+                   help="run the sharded split-brain rounds (N shard "
+                        "leases, two replicas, mid-run leader kill) "
+                        "instead of the single-operator rounds")
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args(argv)
     base = args.seed if args.seed is not None else \
         random.SystemRandom().randint(0, 2**31)
-    print(f"verify-chaos-invariants: {args.rounds} rounds, "
+    round_fn = run_shard_round if args.sharded else run_round
+    mode = "sharded " if args.sharded else ""
+    print(f"verify-chaos-invariants: {args.rounds} {mode}rounds, "
           f"base seed {base}", file=sys.stderr)
     for i in range(args.rounds):
         seed = base + i
-        errors = run_round(seed, timeout=args.timeout,
-                           verbose=args.verbose)
+        errors = round_fn(seed, timeout=args.timeout,
+                          verbose=args.verbose)
         if errors:
-            print(f"FAIL (repro: --seed {seed} --rounds 1):",
+            repro_flag = " --sharded" if args.sharded else ""
+            print(f"FAIL (repro: --seed {seed} --rounds 1{repro_flag}):",
                   file=sys.stderr)
             for e in errors:
                 print(f"  {e}", file=sys.stderr)
             return 1
-    print("OK: converged under every fault profile; no orphans, no "
-          "duplicate admissions, every barrier resolved, no committed "
-          "steps lost, elastic floors/budget held", file=sys.stderr)
+    if args.sharded:
+        print("OK: converged under every fault profile; every sync on "
+              "the owning shard, no double-reconcile, every crashed "
+              "shard re-acquired, no orphans", file=sys.stderr)
+    else:
+        print("OK: converged under every fault profile; no orphans, no "
+              "duplicate admissions, every barrier resolved, no "
+              "committed steps lost, elastic floors/budget held",
+              file=sys.stderr)
     return 0
 
 

@@ -17,7 +17,10 @@ pins the contract — wired into tier-1 as tests/test_flag_parity.py:
 - every serving front-door flag (``--gateway-*`` / ``--autoscale-*`` /
   ``--enable-serving-*``) is documented in docs/serving.md — the
   gateway and autoscaler are operated from that page, so an
-  undocumented knob there is unreachable by its audience.
+  undocumented knob there is unreachable by its audience;
+- every sharding flag (``--shards`` / ``--shard-index``) is documented
+  in docs/robustness.md — the ``--shards`` kube gate sends rejected
+  operators to that page's 'Sharded control plane' section.
 
 Usage: python hack/verify-flag-parity.py   # exit 0 clean, 1 on drift
 """
@@ -39,7 +42,7 @@ DOCS_DIR = os.path.join(REPO, "docs")
 # match.
 _ERROR_CALL = re.compile(r'parser\.error\(\s*((?:"(?:[^"\\]|\\.)*"\s*)+)\)')
 _STR = re.compile(r'"((?:[^"\\]|\\.)*)"')
-_FLAG_AT_START = re.compile(r"^(--enable-[a-z-]+)")
+_FLAG_AT_START = re.compile(r"^(--enable-[a-z-]+|--shards)\b")
 _DOC_CITE = re.compile(r"docs/([a-z0-9_-]+\.md)")
 # Doc-side claims that a flag is unavailable on kube.
 _REJECTION_WORDS = ("not yet supported", "rejects", "rejected")
@@ -69,6 +72,12 @@ def serving_flags() -> Set[str]:
                           "--enable-serving-"))
 
 
+def sharding_flags() -> Set[str]:
+    """The control-plane sharding flag family (--shards,
+    --shard-index): all must be documented in docs/robustness.md."""
+    return _parser_flags(("--shard",))
+
+
 def kube_gates(path: str = CLI) -> Dict[str, Tuple[str, List[str]]]:
     """flag -> (gate message, cited docs files) for every parser.error
     gate that rejects an --enable-* flag on --backend kube."""
@@ -94,7 +103,7 @@ def _doc_paragraphs(path: str) -> List[str]:
 def check(cli_path: str = CLI, docs_dir: str = DOCS_DIR) -> List[str]:
     """All drift findings, empty when cli.py and the docs agree."""
     problems: List[str] = []
-    flags = enable_flags()
+    flags = enable_flags() | sharding_flags()
     gates = kube_gates(cli_path)
 
     for flag, (message, cited) in sorted(gates.items()):
@@ -152,6 +161,20 @@ def check(cli_path: str = CLI, docs_dir: str = DOCS_DIR) -> List[str]:
                 f"{flag} is a serving front-door flag but docs/serving.md "
                 "never mentions it — the gateway/autoscaler page is its "
                 "only discoverable home")
+
+    # Sharding flags must be operable from docs/robustness.md — the
+    # --shards kube gate sends rejected operators there.
+    robustness_doc = os.path.join(docs_dir, "robustness.md")
+    robustness_text = ""
+    if os.path.exists(robustness_doc):
+        with open(robustness_doc, encoding="utf-8") as f:
+            robustness_text = f.read()
+    for flag in sorted(sharding_flags()):
+        if flag not in robustness_text:
+            problems.append(
+                f"{flag} is a control-plane sharding flag but "
+                "docs/robustness.md never mentions it — the 'Sharded "
+                "control plane' section is its only discoverable home")
     return problems
 
 

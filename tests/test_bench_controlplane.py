@@ -246,6 +246,57 @@ def test_oversubscribe_scenario_smoke_and_artifact_schema(capsys):
     assert ENV_KEYS <= set(artifact["env"])
 
 
+def test_sharded_scenario_smoke_and_artifact_schema(capsys):
+    """--shards N: two replicas over N shard leases, a mid-run shard
+    kill, zero-copy watch resume on takeover. The smoke pin: the fleet
+    converges, the killed shard fails over to the standby, ownership
+    evidence comes back EMPTY (every sync on the owning shard, never
+    two live controllers per shard), the takeover rode the watch cache
+    (hit rate 1.0 — no ADDED storm), and the artifact carries the
+    sharded fields the acceptance criteria read."""
+    rc = bench_controlplane.main(["--jobs", "9", "--workers", "2",
+                                  "--shards", "3", "--threadiness", "3",
+                                  "--timeout", "60"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "artifact must be exactly one line"
+    artifact = json.loads(out[0])
+    assert rc == 0, artifact.get("ownership_violations",
+                                 artifact.get("error"))
+    assert artifact["metric"].startswith(
+        "controlplane_sharded_convergence_jobs_per_sec")
+    assert {"shards", "threadiness_per_shard", "per_shard_jobs_per_sec",
+            "shard_reassignments", "watch_cache_hit_rate", "shard_kill",
+            "ownership_violations", "deepcopies_per_sync",
+            "phase_attribution"} <= set(artifact)
+    assert artifact["shards"] == 3
+    assert artifact["threadiness_per_shard"] == 1
+    assert set(artifact["per_shard_jobs_per_sec"]) == {"0", "1", "2"}
+    assert artifact["ownership_violations"] == []
+    # The kill actually happened and the standby adopted the shard.
+    kill = artifact["shard_kill"]
+    assert kill["enabled"] is True
+    assert kill["killed_shard"] == 2
+    assert kill["failover_seconds"] is not None
+    assert artifact["shard_reassignments"] >= 1
+    # Every shard start/takeover resumed from the watch log — zero
+    # full-replay misses.
+    assert artifact["watch_cache_hit_rate"] == 1.0
+    assert ENV_KEYS <= set(artifact["env"])
+
+
+def test_sharded_no_kill_run_skips_failover(capsys):
+    rc = bench_controlplane.main(["--jobs", "4", "--workers", "2",
+                                  "--shards", "2", "--no-kill-shard",
+                                  "--timeout", "60"])
+    assert rc == 0
+    artifact = json.loads(capsys.readouterr().out.strip())
+    kill = artifact["shard_kill"]
+    assert kill["enabled"] is False
+    assert kill["killed_shard"] is None
+    assert kill["failover_seconds"] is None
+    assert artifact["ownership_violations"] == []
+
+
 def test_failure_still_emits_one_json_line(capsys):
     # Impossible timeout: the artifact contract holds on failure too.
     rc = bench_controlplane.main(["--jobs", "2", "--workers", "1",

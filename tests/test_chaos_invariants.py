@@ -46,6 +46,15 @@ PINNED_SEEDS = (100, 103, 1000, 1004, 1015, 1020, 1023)
 # sweeps of the grow/shrink churn.
 ELASTIC_PINNED_SEEDS = (100, 2000, 2002, 2003)
 
+# Sharded split-brain seeds (run_shard_round: two replicas contending
+# for N shard leases, a mid-run shard-holder kill WITHOUT lease
+# release, reconcile through the same fault classes). Clean-coverage
+# sweeps of the 3000 block — 3007 draws the 4-shard double-crash
+# schedule (both replicas lose a shard in one round). Any seed that
+# ever exposes an ownership/double-reconcile regression gets appended
+# here forever, same convention as above.
+SHARD_PINNED_SEEDS = (3000, 3003, 3007)
+
 
 def _load():
     spec = importlib.util.spec_from_file_location("verify_chaos", SCRIPT)
@@ -66,6 +75,13 @@ def test_elastic_pinned_seeds_hold_invariants():
     for seed in ELASTIC_PINNED_SEEDS:
         errors = vc.run_round(seed, timeout=120.0, elastic=True)
         assert not errors, f"seed {seed} (elastic): {errors}"
+
+
+def test_shard_pinned_seeds_hold_invariants():
+    vc = _load()
+    for seed in SHARD_PINNED_SEEDS:
+        errors = vc.run_shard_round(seed, timeout=120.0)
+        assert not errors, f"seed {seed} (sharded): {errors}"
 
 
 def test_cli_entrypoint_runs_clean():

@@ -274,6 +274,63 @@ def test_frozen_claim_snapshot_not_deepcopied_on_keep_path():
     assert {id(p) for p in first} == {id(p) for p in second}
 
 
+def test_steady_state_sync_read_path_is_zero_deepcopy():
+    """The 25%-of-sync ``job.fetch`` deepcopy is gone: a steady-state
+    re-sync reads the job through the working-copy cache (validated
+    against the frozen snapshot by (uid, rv)), pods/endpoints come back
+    as frozen claim snapshots, and with no status diff to write the
+    whole sync performs ZERO ApiObject deepcopies — and zero get()
+    calls (the deepcopying read API)."""
+    from tf_operator_tpu.api.types import ApiObject
+
+    class SnapshotCountingStore(Store):
+        def __init__(self):
+            super().__init__()
+            self.gets = 0
+            self.snapshot_gets = 0
+
+        def get(self, kind, namespace, name):
+            self.gets += 1
+            return super().get(kind, namespace, name)
+
+        def get_snapshot(self, kind, namespace, name):
+            self.snapshot_gets += 1
+            return super().get_snapshot(kind, namespace, name)
+
+    store = SnapshotCountingStore()
+    controller = TPUJobController(store)
+    job = store.create(store_mod.TPUJOBS, testutil.new_tpujob(worker=2))
+    for i in range(2):
+        store.create(store_mod.PODS,
+                     testutil.new_pod(job, "worker", i,
+                                      phase=PodPhase.RUNNING))
+        store.create(store_mod.ENDPOINTS,
+                     testutil.new_endpoint(job, "worker", i))
+    # First syncs build the working copy and settle the status.
+    controller.sync_tpujob(job.key())
+    controller.sync_tpujob(job.key())
+
+    store.gets = 0
+    store.snapshot_gets = 0
+    orig = ApiObject.deepcopy
+    copies = [0]
+
+    def counted(obj):
+        copies[0] += 1
+        return orig(obj)
+
+    ApiObject.deepcopy = counted
+    try:
+        controller.sync_tpujob(job.key())
+    finally:
+        ApiObject.deepcopy = orig
+
+    assert copies[0] == 0, (
+        f"steady-state sync performed {copies[0]} deepcopies")
+    assert store.gets == 0, "sync used the deepcopying get() read path"
+    assert store.snapshot_gets >= 1  # the cache-validation read
+
+
 def test_garbage_collect_uses_owner_index():
     """GC of a deleted job's residue is O(owned): objects of OTHER jobs
     in the namespace are untouched and never even visited (owner index,

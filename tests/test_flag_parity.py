@@ -41,11 +41,14 @@ def test_checker_sees_the_real_contract():
     assert {"--enable-gang-scheduling", "--enable-tenant-queues",
             "--enable-ckpt-coordination", "--enable-serving",
             "--enable-elastic"} <= flags
-    # The node-agent relay lifted every kube gate except elastic — and
-    # the serving autoscaler rides the elastic resize pass, so it
-    # inherits the same gate (docs/serving.md).
+    # The node-agent relay lifted every kube gate except elastic — the
+    # serving autoscaler rides the elastic resize pass, so it inherits
+    # the same gate (docs/serving.md) — and shard leases live in the
+    # in-process store, so --shards > 1 is rejected on kube until the
+    # kube lease client lands (docs/robustness.md).
     assert set(gates) == {"--enable-elastic",
-                          "--enable-serving-autoscaler"}
+                          "--enable-serving-autoscaler",
+                          "--shards"}
     message, cited = gates["--enable-elastic"]
     assert "elastic.md" in "".join(cited)
     # The lifted flags must NOT be gated anymore.

@@ -215,5 +215,173 @@ def test_owner_index_follows_release():
     assert s.owned_keys(store_mod.PODS, job.metadata.uid) == []
 
 
+# --- zero-copy reads + watch cache (sharded control plane, ISSUE 19) ------
+
+
+class _CopyCounter:
+    """Counts ApiObject.deepcopy calls inside a with-block."""
+
+    def __enter__(self):
+        from tf_operator_tpu.api.types import ApiObject
+
+        self._cls = ApiObject
+        self._orig = ApiObject.deepcopy
+        self.count = 0
+        counter = self
+
+        def counted(obj):
+            counter.count += 1
+            return counter._orig(obj)
+
+        ApiObject.deepcopy = counted
+        return self
+
+    def __exit__(self, *exc):
+        self._cls.deepcopy = self._orig
+        return False
+
+
+def test_get_snapshot_returns_frozen_object_without_copy():
+    """The sync read path: get_snapshot hands out the stored immutable
+    snapshot itself — zero deepcopies, identity-stable until the next
+    write replaces the slot."""
+    s = Store()
+    created = s.create(store_mod.TPUJOBS, testutil.new_tpujob(worker=1))
+    name = created.metadata.name
+    with _CopyCounter() as copies:
+        first = s.get_snapshot(store_mod.TPUJOBS, "default", name)
+        again = s.get_snapshot(store_mod.TPUJOBS, "default", name)
+    assert copies.count == 0
+    assert first is again  # the stored snapshot, not a copy
+    assert s.get_snapshot(store_mod.TPUJOBS, "default", "nope") is None
+    update = first.deepcopy()
+    s.update_status(store_mod.TPUJOBS, update)
+    fresh = s.get_snapshot(store_mod.TPUJOBS, "default", name)
+    assert fresh is not first  # write REPLACED the slot
+    assert (first.metadata.resource_version
+            < fresh.metadata.resource_version)
+
+
+def test_watch_fanout_is_one_deepcopy_per_event():
+    """W watchers receive ONE shared copy per event, not W copies —
+    the fan-out allocation fix. Identity across handlers proves the
+    share; the counter pins the per-event allocation at exactly 1."""
+    s = Store()
+    received = {i: [] for i in range(3)}
+    done = threading.Event()
+
+    def make_handler(i):
+        def handler(etype, obj):
+            received[i].append(obj)
+            if all(received.values()):
+                done.set()
+        return handler
+
+    for i in range(3):
+        s.watch(store_mod.TPUJOBS, make_handler(i), replay=False)
+    with _CopyCounter() as copies:
+        s.create(store_mod.TPUJOBS, testutil.new_tpujob(worker=1))
+        assert done.wait(2.0)
+    a, b, c = (received[i][0] for i in range(3))
+    assert a is b is c  # one shared snapshot across the fan-out
+    # create() copies once for the stored snapshot and once for the
+    # fan-out — watcher count must not appear in the total.
+    assert copies.count <= 2
+    assert a is not s.get_snapshot(store_mod.TPUJOBS, "default",
+                                   a.metadata.name)
+    s.stop_watchers()
+
+
+def test_watch_since_rv_replays_only_missed_events():
+    """Reconnect path: a watcher resuming from a resourceVersion it has
+    already seen gets exactly the missed deltas from the watch log (a
+    cache hit) — NOT the full ADDED storm."""
+    s = Store()
+    for i in range(3):
+        s.create(store_mod.TPUJOBS,
+                 testutil.new_tpujob(worker=1, name=f"j{i}"))
+    resume_rv = s.latest_rv()
+    s.create(store_mod.TPUJOBS, testutil.new_tpujob(worker=1, name="j3"))
+    got = []
+    done = threading.Event()
+
+    def handler(etype, obj):
+        got.append((etype, obj.metadata.name))
+        done.set()
+
+    hits0, misses0 = s.watch_cache_hits, s.watch_cache_misses
+    s.watch(store_mod.TPUJOBS, handler, replay=True, since_rv=resume_rv)
+    assert done.wait(2.0)
+    assert got == [(store_mod.ADDED, "j3")]  # only the missed delta
+    assert s.watch_cache_hits == hits0 + 1
+    assert s.watch_cache_misses == misses0
+    s.stop_watchers()
+
+
+def test_watch_since_rv_past_eviction_falls_back_to_full_replay(
+        monkeypatch):
+    """When the watch log has evicted past the resume point the watcher
+    gets the full ADDED replay (the reflector relist contract) and the
+    miss is counted."""
+    monkeypatch.setattr(store_mod, "WATCH_LOG_CAPACITY", 2)
+    s = Store()
+    first = s.create(store_mod.TPUJOBS,
+                     testutil.new_tpujob(worker=1, name="j0"))
+    resume_rv = first.metadata.resource_version
+    for i in range(1, 5):  # evicts j0's entry from the 2-slot log
+        s.create(store_mod.TPUJOBS,
+                 testutil.new_tpujob(worker=1, name=f"j{i}"))
+    got = []
+    done = threading.Event()
+
+    def handler(etype, obj):
+        got.append((etype, obj.metadata.name))
+        if len(got) >= 5:
+            done.set()
+
+    misses0 = s.watch_cache_misses
+    s.watch(store_mod.TPUJOBS, handler, replay=True, since_rv=resume_rv)
+    assert done.wait(2.0)
+    assert sorted(n for _, n in got) == [f"j{i}" for i in range(5)]
+    assert all(et == store_mod.ADDED for et, _ in got)
+    assert s.watch_cache_misses == misses0 + 1
+    s.stop_watchers()
+
+
+def test_list_page_exactly_once_under_concurrent_writes():
+    """Keyset pagination contract: a page walk sees every object that
+    exists for the walk's whole duration EXACTLY once, even when
+    objects are updated (rv churn) and created between pages."""
+    s = Store()
+    for i in range(20):
+        s.create(store_mod.TPUJOBS,
+                 testutil.new_tpujob(worker=1, name=f"job-{i:03d}"))
+    original = {f"job-{i:03d}" for i in range(20)}
+
+    seen = []
+    after = None
+    page = 0
+    while True:
+        items, after, rv = s.list_page(store_mod.TPUJOBS,
+                                       namespace="default",
+                                       limit=6, after=after)
+        assert rv >= s.latest_rv() - 3  # cut at the live store version
+        seen.extend(o.metadata.name for o in items)
+        if after is None:
+            break
+        # Concurrent churn between pages: update an already-seen
+        # object (rv bump must not resurface it) and create a new one
+        # BEFORE the cursor (must not surface mid-walk either).
+        victim = s.get(store_mod.TPUJOBS, "default", seen[0])
+        s.update_status(store_mod.TPUJOBS, victim)
+        s.create(store_mod.TPUJOBS, testutil.new_tpujob(
+            worker=1, name=f"aaa-new-{page}"))
+        page += 1
+
+    assert len(seen) == len(set(seen)), "an object surfaced twice"
+    assert original <= set(seen), "an original object was skipped"
+    assert s.list_pages == page + 1
+
+
 # CI shard (pyproject [tool.pytest.ini_options] markers)
 pytestmark = pytest.mark.control_plane

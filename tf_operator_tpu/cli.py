@@ -316,6 +316,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run leader election before reconciling")
     p.add_argument("--leader-elect-identity", default=None,
                    help="lease holder identity (default: generated)")
+    p.add_argument("--shards", type=int, default=1,
+                   help="shard the control plane across N leases "
+                        "(tpu-operator-shard-<i>): jobs hash to a shard "
+                        "by (namespace, uid) and each held shard runs a "
+                        "full engine over only its jobs; replicas "
+                        "contend per shard, so a killed holder's shards "
+                        "fail over to survivors (docs/benchmarks.md). "
+                        "1 = the classic singleton lease. Requires the "
+                        "in-process store (local/none backends); with "
+                        "--backend kube the informer cache is per-"
+                        "replica, so shard ownership there must come "
+                        "from N Lease objects in the cluster — not "
+                        "wired yet (docs/robustness.md)")
+    p.add_argument("--shard-index", type=int, default=None,
+                   help="contend for ONLY this shard's lease instead of "
+                        "all of them — one-shard-per-process "
+                        "deployments (default: contend for every "
+                        "shard)")
     return p
 
 
@@ -410,7 +428,7 @@ class Server:
             op_kwargs = {}
             if getattr(args, "backend", "local") == "none":
                 op_kwargs["backend"] = None
-            self.operator = Operator(
+            shared_kwargs = dict(
                 store=self.store,
                 namespace=args.namespace or None,
                 # Slice health needs gang displace/readmit to repair, so
@@ -425,6 +443,22 @@ class Server:
                 degraded_after_seconds=getattr(
                     args, "degraded_after_seconds", 10.0),
                 **gang_kwargs, **tenant_kwargs, **op_kwargs)
+            shards = getattr(args, "shards", 1)
+            if shards > 1:
+                # N-leader mode: the per-shard leases ARE the leader
+                # election, so the singleton elector below is skipped.
+                from tf_operator_tpu.operator import ShardedOperator
+
+                self.operator = ShardedOperator(
+                    shards,
+                    identity=args.leader_elect_identity,
+                    shard_index=getattr(args, "shard_index", None),
+                    lease_duration=LEASE_DURATION,
+                    renew_deadline=RENEW_DEADLINE,
+                    retry_period=RETRY_PERIOD,
+                    **shared_kwargs)
+            else:
+                self.operator = Operator(**shared_kwargs)
         self.api_server = None
         if getattr(args, "api_port", 0) != 0:
             from tf_operator_tpu.runtime.apiserver import APIServer
@@ -487,7 +521,7 @@ class Server:
                 port=max(args.monitoring_port, 0),
                 host=args.monitoring_host)
         self.elector: Optional[LeaderElector] = None
-        if args.leader_elect:
+        if args.leader_elect and getattr(args, "shards", 1) <= 1:
             self.elector = LeaderElector(
                 self._lease_store or self.store,
                 identity=args.leader_elect_identity,
@@ -540,9 +574,15 @@ class Server:
         in the watched scope (reference: 15s ReconcilerSyncLoopPeriod via
         informer resync)."""
         while not self._stop.wait(self.args.resync_period):
-            for job in self.store.list(store_mod.TPUJOBS,
-                                       namespace=self.args.namespace or None):
-                self.operator.controller.enqueue(job.key())
+            if hasattr(self.operator, "resync"):
+                # Sharded mode: route each job to its holding shard's
+                # controller (frozen-snapshot walk, no deepcopies).
+                self.operator.resync()
+                continue
+            for ns, name, _ in self.store.keys(store_mod.TPUJOBS):
+                if self.args.namespace and ns != self.args.namespace:
+                    continue
+                self.operator.controller.enqueue(f"{ns}/{name}")
 
     def start(self) -> None:
         if self.api_server is not None:
@@ -624,6 +664,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                      "pass, which kube does not run yet "
                      "(docs/elastic.md Scope, docs/serving.md); use "
                      "the local or served backend")
+    if args.shards < 1:
+        parser.error("--shards must be >= 1")
+    if args.shard_index is not None and not (
+            0 <= args.shard_index < args.shards):
+        parser.error(f"--shard-index {args.shard_index} is out of range "
+                     f"for --shards {args.shards}: valid indices are "
+                     f"0..{args.shards - 1}")
+    if args.shards > 1 and args.backend == "kube":
+        parser.error("--shards > 1 is not yet supported with --backend "
+                     "kube: shard leases live in the in-process store, "
+                     "but the kube Store is a per-replica informer "
+                     "cache — cross-replica shard ownership there needs "
+                     "N Lease objects in the cluster (docs/robustness.md "
+                     "'Sharded control plane'); use the local or served "
+                     "backend")
+    if args.shards > 1 and not args.leader_elect:
+        parser.error("--shards > 1 requires leader election: the "
+                     "per-shard leases ARE the election (jobs follow "
+                     "shard ownership), so --no-leader-elect would "
+                     "leave every shard unowned")
     if args.backend == "kube" and args.api_port != 0:
         parser.error("--backend kube cannot serve --api-port: the Store "
                      "is a read cache of the cluster there, so jobs "

@@ -239,12 +239,15 @@ class JobEngine:
         with trace_mod.span("pods.list"):
             pods = self.plugin.get_pods_for_job(job)
             endpoints = self.plugin.get_endpoints_for_job(job)
-        old_status = job.status.deepcopy()
+        # Change detection wants the dict form anyway (see status.diff
+        # below), so capture it directly — a status deepcopy per sync
+        # bought nothing over the serialized snapshot.
+        old_status_dict = job.status.to_dict()
 
         if cond.is_finished(job.status):
             with trace_mod.span("finalize"):
                 self._finalize_finished_job(job, pods)
-                if job.status.to_dict() != old_status.to_dict():
+                if job.status.to_dict() != old_status_dict:
                     self.plugin.update_job_status_in_api(job)
             return
 
@@ -419,7 +422,7 @@ class JobEngine:
         with trace_mod.span("status.rollup"):
             self.plugin.update_job_status(job, replica_specs, pods)
         with trace_mod.span("status.diff"):
-            changed = job.status.to_dict() != old_status.to_dict()
+            changed = job.status.to_dict() != old_status_dict
         if changed:
             self.plugin.update_job_status_in_api(job)
 

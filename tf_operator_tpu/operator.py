@@ -8,8 +8,9 @@ monitoring endpoint attach here; see cli.py).
 from __future__ import annotations
 
 import logging
+import threading
 import uuid
-from typing import Optional
+from typing import Dict, Optional
 
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import EventRecord, ObjectMeta
@@ -18,7 +19,7 @@ from tf_operator_tpu.controller.gang import SliceGangScheduler
 from tf_operator_tpu.controller.tpu_controller import TPUJobController
 from tf_operator_tpu.runtime.events import Recorder
 from tf_operator_tpu.runtime.local import LocalProcessBackend
-from tf_operator_tpu.runtime.store import EVENTS, Store
+from tf_operator_tpu.runtime.store import EVENTS, TPUJOBS, Store
 
 # Store-mirrored events are capped like the in-memory Recorder: when the
 # collection exceeds MAX_STORED_EVENTS, the oldest PRUNE_BATCH are dropped.
@@ -56,7 +57,9 @@ class Operator:
                  resize_signals=None,
                  enable_slice_health: bool = False,
                  health_drain_grace_seconds: float = 0.0,
-                 degraded_after_seconds: float = 10.0):
+                 degraded_after_seconds: float = 10.0,
+                 shard_index: Optional[int] = None,
+                 shard_count: int = 1):
         from tf_operator_tpu.runtime.retry import ControlPlaneHealth
 
         self.store = store or Store()
@@ -156,7 +159,9 @@ class Operator:
                                            namespace=namespace,
                                            ckpt=self.ckpt,
                                            cp_health=self.cp_health,
-                                           serving=self.serving)
+                                           serving=self.serving,
+                                           shard_index=shard_index,
+                                           shard_count=shard_count)
         if self.ckpt is not None and gang is not None:
             # A barrier ack landing between resyncs must release the
             # held eviction promptly: record writes poke admission.
@@ -219,7 +224,7 @@ class Operator:
         except Exception:
             log.debug("event persist failed", exc_info=True)
 
-    def stop(self) -> None:
+    def stop(self, stop_store_watchers: bool = True) -> None:
         if self.autoscaler is not None:
             self.autoscaler.stop()
         if self.health is not None:
@@ -229,7 +234,10 @@ class Operator:
             self.backend.stop()
         if self.ckpt is not None:
             self.ckpt.stop()
-        self.store.stop_watchers()
+        # A sharded replica tears down per-shard operators on lease loss
+        # without killing the shared store's other watchers.
+        if stop_store_watchers:
+            self.store.stop_watchers()
 
     @classmethod
     def local(cls, workdir: str, extra_env: Optional[dict] = None,
@@ -247,3 +255,146 @@ class Operator:
         op = cls(backend=backend, **kwargs)
         backend.store = op.store
         return op
+
+
+class ShardedOperator:
+    """N-leader control plane: one Lease per shard
+    (``tpu-operator-shard-<i>``), jobs hashed to shards by
+    ``(namespace, uid)``. Each held shard runs a FULL engine —
+    workqueue, expectations, gang/quota/ckpt plugins — over only its
+    own jobs; chip-budget and quota stay globally consistent through
+    the store's CAS semantics and the admission plan ledger, so no
+    cross-shard lock is needed.
+
+    One data-plane backend is shared by every shard of this replica.
+    Per-shard :class:`Operator` instances (``backend=None``) are built
+    on lease acquisition and torn down on loss WITHOUT stopping the
+    shared store's watchers, so a lost shard never takes down the
+    survivors' event flow. A second replica contends for the same
+    leases: kill one holder and its shards fail over.
+    """
+
+    def __init__(self, shards: int, store: Optional[Store] = None,
+                 backend=_DEFAULT_BACKEND,
+                 identity: Optional[str] = None,
+                 namespace: Optional[str] = None,
+                 shard_index: Optional[int] = None,
+                 lease_duration: float = 15.0,
+                 renew_deadline: float = 5.0,
+                 retry_period: float = 3.0,
+                 **operator_kwargs):
+        from tf_operator_tpu.runtime.leaderelection import ShardMap
+
+        if shards < 1:
+            raise ValueError("shards must be >= 1")
+        self.shards = shards
+        self.store = store or Store()
+        self.backend = (LocalProcessBackend(self.store)
+                        if backend is _DEFAULT_BACKEND else backend)
+        self.namespace = namespace
+        self._operator_kwargs = dict(operator_kwargs)
+        self._threadiness = 2
+        self._lock = threading.Lock()
+        self._shard_ops: Dict[int, Operator] = {}
+        self._started = False
+        self.shard_map = ShardMap(
+            self.store, shards, identity=identity,
+            namespace=namespace or "default",
+            shard_index=shard_index,
+            lease_duration=lease_duration,
+            renew_deadline=renew_deadline,
+            retry_period=retry_period,
+            on_shard_acquired=self._on_shard_acquired,
+            on_shard_lost=self._on_shard_lost)
+        if self.backend is not None and hasattr(self.backend,
+                                                "on_gang_drained"):
+            self.backend.on_gang_drained = self._readmit_all
+
+    # -- shard lifecycle -------------------------------------------------
+
+    def _on_shard_acquired(self, index: int) -> None:
+        with self._lock:
+            if index in self._shard_ops:
+                return
+            op = Operator(store=self.store, backend=None,
+                          namespace=self.namespace,
+                          shard_index=index, shard_count=self.shards,
+                          **self._operator_kwargs)
+            gang = op.controller.engine.gang
+            if gang is not None and hasattr(self.backend,
+                                            "draining_gang_groups"):
+                gang.draining_provider = self.backend.draining_gang_groups
+            self._shard_ops[index] = op
+            started = self._started
+        if started:
+            op.start(threadiness=self._threadiness)
+        log.info("shard %d acquired by %s", index, self.shard_map.identity)
+
+    def _on_shard_lost(self, index: int) -> None:
+        with self._lock:
+            op = self._shard_ops.pop(index, None)
+        if op is not None:
+            op.stop(stop_store_watchers=False)
+        log.info("shard %d lost by %s", index, self.shard_map.identity)
+
+    def _readmit_all(self) -> None:
+        with self._lock:
+            ops = list(self._shard_ops.values())
+        for op in ops:
+            gang = op.controller.engine.gang
+            if gang is not None:
+                try:
+                    gang.readmit()
+                except Exception:
+                    log.debug("shard readmit failed", exc_info=True)
+
+    # -- operator surface ------------------------------------------------
+
+    @property
+    def held_shards(self):
+        return self.shard_map.held()
+
+    def operator_for(self, index: int) -> Optional[Operator]:
+        with self._lock:
+            return self._shard_ops.get(index)
+
+    def start(self, threadiness: int = 2) -> None:
+        with self._lock:
+            self._threadiness = threadiness
+            self._started = True
+            pending = list(self._shard_ops.values())
+        if self.backend is not None:
+            self.backend.start()
+        for op in pending:
+            op.start(threadiness=threadiness)
+        self.shard_map.start()
+        log.info("sharded operator started (shards=%d, threadiness=%d)",
+                 self.shards, threadiness)
+
+    def resync(self) -> None:
+        """Enqueue every owned job on its holding shard's controller —
+        the sharded analog of the flat resync loop. Walks key metadata
+        and frozen snapshots only (no deepcopies)."""
+        from tf_operator_tpu.runtime.leaderelection import shard_for
+
+        for ns, name, _ in self.store.keys(TPUJOBS):
+            if self.namespace is not None and ns != self.namespace:
+                continue
+            snap = self.store.get_snapshot(TPUJOBS, ns, name)
+            if snap is None:
+                continue
+            idx = shard_for(ns, snap.metadata.uid, self.shards)
+            op = self.operator_for(idx)
+            if op is not None:
+                op.controller.enqueue(f"{ns}/{name}")
+
+    def stop(self) -> None:
+        self.shard_map.stop()
+        with self._lock:
+            ops = list(self._shard_ops.values())
+            self._shard_ops.clear()
+        for op in ops:
+            op.stop(stop_store_watchers=False)
+        if self.backend is not None:
+            self.backend.stop()
+        self.store.stop_watchers()

@@ -12,8 +12,12 @@ Wire contract (all JSON):
 
   GET    /healthz                         -> {"status": "ok"}
   GET    /version                         -> {"version": ...}
-  GET    /apis/v1/{kind}                  -> {"items": [...]}
-         ?namespace=ns&labelSelector=k=v,k2=v2
+  GET    /apis/v1/{kind}                  -> {"items": [...],
+         "resourceVersion": N, "continue": token-or-""}
+         ?namespace=ns&labelSelector=k=v,k2=v2&limit=N&continue=token
+         (limit pages the keyset walk; pass the returned continue token
+         to fetch the next page — every object present for the whole
+         walk appears exactly once)
   POST   /apis/v1/{kind}                  -> created object
   GET    /apis/v1/{kind}/{ns}/{name}      -> object
   PUT    /apis/v1/{kind}/{ns}/{name}      -> updated object
@@ -21,7 +25,10 @@ Wire contract (all JSON):
   DELETE /apis/v1/{kind}/{ns}/{name}      -> {}
   GET    /apis/v1/watch/{kind}            -> JSON-lines stream of
          {"type": ADDED|MODIFIED|DELETED, "object": {...}}; existing
-         objects replay as ADDED; blank keepalive lines every few seconds.
+         objects replay as ADDED; blank keepalive lines every few
+         seconds. ?resourceVersion=N resumes from the store's watch
+         log — only events newer than N replay (no ADDED storm); an
+         RV already evicted from the log degrades to the full replay.
   GET    /logs/{ns}/{pod}?follow=1&tailLines=N -> text/plain pod log,
          proxied from the owning node agent (kubelet log API analog).
 
@@ -47,6 +54,7 @@ equivalents, runtime/tlsutil.py):
 
 from __future__ import annotations
 
+import base64
 import hmac
 import json
 import logging
@@ -91,6 +99,26 @@ WIRE_KINDS: Dict[str, Type[ApiObject]] = {
 }
 
 _WATCH_KEEPALIVE_SECONDS = 3.0
+
+
+def encode_continue(after) -> str:
+    """Opaque continue token for list pagination: base64 of the last
+    returned (namespace, name) key — the resume point of the store's
+    keyset walk (K8s continue-token analog)."""
+    return base64.urlsafe_b64encode(
+        json.dumps(list(after)).encode()).decode()
+
+
+def decode_continue(token: str):
+    try:
+        pair = json.loads(base64.urlsafe_b64decode(token.encode()))
+        if (not isinstance(pair, list) or len(pair) != 2
+                or not all(isinstance(x, str) for x in pair)):
+            raise ValueError(pair)
+        return tuple(pair)
+    except Exception:
+        raise _ApiError(400, "BadRequest",
+                        f"malformed continue token {token!r}")
 
 
 def parse_label_selector(raw: str) -> Dict[str, str]:
@@ -266,9 +294,32 @@ class _Handler(BaseHTTPRequestHandler):
                         selector = parse_label_selector(raw_sel)
                     except ValueError as e:
                         raise _ApiError(400, "BadRequest", str(e))
-                items = _store_call(self.store.list, rest[0], ns, selector)
-                return self._send_json(
-                    200, {"items": [o.to_dict() for o in items]})
+                limit = None
+                raw_limit = (query.get("limit") or [None])[0]
+                if raw_limit:
+                    try:
+                        limit = int(raw_limit)
+                    except ValueError:
+                        raise _ApiError(400, "BadRequest",
+                                        f"invalid limit {raw_limit!r}")
+                    if limit < 1:
+                        raise _ApiError(400, "BadRequest",
+                                        "limit must be >= 1")
+                after = None
+                raw_cont = (query.get("continue") or [None])[0]
+                if raw_cont:
+                    after = decode_continue(raw_cont)
+                # Frozen snapshots straight out of the watch cache: the
+                # page is serialized without a single deepcopy.
+                items, next_after, rv = _store_call(
+                    self.store.list_page, rest[0], ns, selector, limit,
+                    after)
+                return self._send_json(200, {
+                    "items": [o.to_dict() for o in items],
+                    "resourceVersion": rv,
+                    "continue": (encode_continue(next_after)
+                                 if next_after else ""),
+                })
             if len(rest) == 3:        # get
                 self._kind(rest[0])
                 obj = _store_call(self.store.get, rest[0], rest[1], rest[2])
@@ -339,6 +390,14 @@ class _Handler(BaseHTTPRequestHandler):
     def _serve_watch(self, kind: str, query) -> None:
         self._kind(kind)
         ns = (query.get("namespace") or [None])[0]
+        since_rv = None
+        raw_rv = (query.get("resourceVersion") or [None])[0]
+        if raw_rv:
+            try:
+                since_rv = int(raw_rv)
+            except ValueError:
+                raise _ApiError(400, "BadRequest",
+                                f"invalid resourceVersion {raw_rv!r}")
         self.send_response(200)
         self.send_header("Content-Type", "application/jsonlines")
         self.send_header("Cache-Control", "no-cache")
@@ -349,8 +408,12 @@ class _Handler(BaseHTTPRequestHandler):
 
         import queue as _q
         events: "_q.Queue" = _q.Queue()
+        # since_rv resumes from the store's watch log (replaying only
+        # missed events) instead of a full ADDED storm; an evicted RV
+        # silently degrades to the full replay.
         watcher = self.store.watch(kind,
-                                   lambda et, obj: events.put((et, obj)))
+                                   lambda et, obj: events.put((et, obj)),
+                                   since_rv=since_rv)
         try:
             while True:
                 try:

@@ -240,6 +240,19 @@ class ChaosStore:
         except store_mod.NotFoundError:
             return None
 
+    def get_snapshot(self, kind: str, namespace: str, name: str):
+        """Frozen-snapshot read with the same fault surface as get:
+        injected timeouts/5xx, and stale reads served from the history
+        pool (a historic version is frozen too — the consumer contract
+        is identical)."""
+        self._maybe_read_fault("get", kind)
+        if self.injector.decide("stale_read", "get", kind):
+            with self._hist_lock:
+                stale = self._history.get((kind, namespace, name))
+            if stale is not None:
+                return stale
+        return self.inner.get_snapshot(kind, namespace, name)
+
     def list(self, kind: str, namespace=None, selector=None):
         self._maybe_read_fault("list", kind)
         return self.inner.list(kind, namespace=namespace,
@@ -290,9 +303,20 @@ class ChaosStore:
     def keys(self, kind: str):
         return self.inner.keys(kind)
 
+    def latest_rv(self) -> int:
+        return self.inner.latest_rv()
+
+    def list_page(self, kind: str, namespace=None, selector=None,
+                  limit=None, after=None):
+        self._maybe_read_fault("list", kind)
+        return self.inner.list_page(kind, namespace=namespace,
+                                    selector=selector, limit=limit,
+                                    after=after)
+
     # -- watch -----------------------------------------------------------
 
-    def watch(self, kind: str, handler, replay: bool = True):
+    def watch(self, kind: str, handler, replay: bool = True,
+              since_rv=None):
         injector = self.injector
 
         def chaotic(etype, obj):
@@ -300,7 +324,8 @@ class ChaosStore:
                 return  # silently lost on the wire
             handler(etype, obj)
 
-        return self.inner.watch(kind, chaotic, replay=replay)
+        return self.inner.watch(kind, chaotic, replay=replay,
+                                since_rv=since_rv)
 
     def stop_watchers(self) -> None:
         self.inner.stop_watchers()

@@ -16,12 +16,14 @@ from __future__ import annotations
 
 import dataclasses
 import datetime as _dt
+import hashlib
 import logging
 import threading
 import uuid
-from typing import Callable, Optional
+from typing import Callable, Dict, List, Optional, Set
 
 from tf_operator_tpu.api.types import ApiObject, ObjectMeta
+from tf_operator_tpu.runtime import metrics as metrics_mod
 from tf_operator_tpu.runtime import store as store_mod
 from tf_operator_tpu.runtime.metrics import is_leader as is_leader_gauge
 from tf_operator_tpu.runtime.store import Store
@@ -30,6 +32,22 @@ log = logging.getLogger("tpu_operator.leaderelection")
 
 LEASES = "leases"
 DEFAULT_LOCK_NAME = "tpu-operator"
+
+
+def shard_for(namespace: str, uid: str, shards: int) -> int:
+    """Stable job->shard assignment: sha1 over (namespace, uid). Every
+    replica computes the same mapping with no coordination; a job never
+    migrates between shards for its lifetime (uid is immutable), so two
+    shard holders can never both believe they own it."""
+    if shards <= 1:
+        return 0
+    digest = hashlib.sha1(f"{namespace}/{uid}".encode()).digest()
+    return int.from_bytes(digest[:4], "big") % shards
+
+
+def shard_lock_name(index: int) -> str:
+    """Lease name for control-plane shard ``index``."""
+    return f"{DEFAULT_LOCK_NAME}-shard-{index}"
 
 
 def _now() -> _dt.datetime:
@@ -217,3 +235,165 @@ class LeaderElector:
         if self._thread is not None and self._thread is not threading.current_thread():
             self._thread.join(timeout=5)
         self.release()
+
+
+class ShardMap:
+    """N-leader job ownership: one Lease per control-plane shard
+    (``tpu-operator-shard-<i>``), each contended independently with the
+    singleton LeaderElector protocol. Jobs hash to shards via
+    :func:`shard_for`; the holder of shard i runs a full engine over
+    only that shard's jobs.
+
+    A replica contends for EVERY shard by default (so one replica can
+    own the whole map — the single-process degenerate case) or for one
+    pinned shard (``shard_index``). Failover needs no new protocol: a
+    dead holder's lease expires and a survivor's elector takes it over;
+    ``on_shard_acquired``/``on_shard_lost`` fire per shard so the
+    caller builds and tears down the shard-scoped engine.
+
+    Unlike the singleton elector (whose run() returns after stepdown —
+    the reference fatals there), a shard loop RE-CONTENDS after losing:
+    shard ownership is a pool, not a process lifetime.
+    """
+
+    def __init__(self, store: Store, shards: int,
+                 identity: Optional[str] = None,
+                 namespace: str = "default",
+                 shard_index: Optional[int] = None,
+                 lease_duration: float = 15.0,
+                 renew_deadline: float = 5.0,
+                 retry_period: float = 3.0,
+                 on_shard_acquired: Optional[Callable[[int], None]] = None,
+                 on_shard_lost: Optional[Callable[[int], None]] = None):
+        if shards < 1:
+            raise ValueError("shards must be >= 1")
+        if shard_index is not None and not 0 <= shard_index < shards:
+            raise ValueError(
+                f"shard_index {shard_index} out of range [0, {shards})")
+        self.store = store
+        self.shards = shards
+        self.identity = (identity
+                         or f"{DEFAULT_LOCK_NAME}-{uuid.uuid4().hex[:8]}")
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.on_shard_acquired = on_shard_acquired
+        self.on_shard_lost = on_shard_lost
+        # Shards this replica contends for (all, unless pinned).
+        self._targets: List[int] = ([shard_index] if shard_index is not None
+                                    else list(range(shards)))
+        self._stop = threading.Event()
+        self._held: Set[int] = set()
+        self._held_lock = threading.Lock()
+        self._crashed: Set[int] = set()
+        self._electors: Dict[int, LeaderElector] = {}
+        self._threads: List[threading.Thread] = []
+        # Takeovers of a previously-held lease observed at acquire time
+        # (mirrors tpu_operator_shard_reassignments_total for benches).
+        self.reassignments = 0
+        self._transitions_seen: Dict[int, int] = {}
+
+    def held(self) -> Set[int]:
+        with self._held_lock:
+            return set(self._held)
+
+    def is_held(self, index: int) -> bool:
+        with self._held_lock:
+            return index in self._held
+
+    def wait_until_held(self, count: int,
+                        timeout: Optional[float] = None) -> bool:
+        """Block until this replica holds at least ``count`` shards."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while len(self.held()) < count:
+            if deadline is not None and _time.monotonic() > deadline:
+                return False
+            if self._stop.wait(0.02):
+                return False
+        return True
+
+    # -- per-shard contention loop ---------------------------------------
+
+    def _shard_loop(self, index: int) -> None:
+        while not self._stop.is_set() and index not in self._crashed:
+            elector = LeaderElector(
+                self.store, identity=self.identity,
+                namespace=self.namespace, name=shard_lock_name(index),
+                lease_duration=self.lease_duration,
+                renew_deadline=self.renew_deadline,
+                retry_period=self.retry_period,
+                on_started_leading=lambda i=index: self._acquired(i),
+                on_stopped_leading=lambda i=index: self._lost(i))
+            self._electors[index] = elector
+            elector.run()  # blocks: acquire -> renew -> stepdown/stop
+
+    def _acquired(self, index: int) -> None:
+        with self._held_lock:
+            self._held.add(index)
+        metrics_mod.shard_owner.set(1, shard=str(index))
+        lease = self.store.try_get(LEASES, self.namespace,
+                                   shard_lock_name(index))
+        transitions = 0 if lease is None else lease.spec.lease_transitions
+        if transitions > self._transitions_seen.get(index, 0):
+            # The lease changed hands to get here — a failover
+            # adoption, not a first acquisition.
+            self.reassignments += 1
+            metrics_mod.shard_reassignments.inc()
+        self._transitions_seen[index] = transitions
+        log.info("shard %d/%d acquired by %s (lease transitions: %d)",
+                 index, self.shards, self.identity, transitions)
+        if self.on_shard_acquired is not None:
+            self.on_shard_acquired(index)
+
+    def _lost(self, index: int) -> None:
+        with self._held_lock:
+            self._held.discard(index)
+        metrics_mod.shard_owner.set(0, shard=str(index))
+        log.warning("shard %d lost by %s; re-contending", index,
+                    self.identity)
+        if self.on_shard_lost is not None:
+            self.on_shard_lost(index)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        for i in self._targets:
+            t = threading.Thread(target=self._shard_loop, args=(i,),
+                                 name=f"shard-elect-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def crash(self, index: int) -> None:
+        """Simulate holder death for one shard: stop renewing WITHOUT
+        releasing the lease and WITHOUT firing on_shard_lost — exactly
+        what a killed process leaves behind. A survivor must wait out
+        the lease duration before adopting (availability cost), and the
+        caller is responsible for abandoning the shard's engine (e.g.
+        chaos.crash_controller). stop() is the graceful counterpart."""
+        self._crashed.add(index)
+        elector = self._electors.get(index)
+        if elector is not None:
+            elector.on_stopped_leading = None
+            elector._stop.set()
+            elector._leading.clear()
+        with self._held_lock:
+            self._held.discard(index)
+        metrics_mod.shard_owner.set(0, shard=str(index))
+
+    def stop(self) -> None:
+        """Graceful stop: release every held lease so standbys take
+        over instantly. on_shard_lost does NOT fire (the caller is
+        tearing everything down itself)."""
+        self._stop.set()
+        for elector in list(self._electors.values()):
+            elector.stop()
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5)
+        with self._held_lock:
+            held, self._held = set(self._held), set()
+        for i in held:
+            metrics_mod.shard_owner.set(0, shard=str(i))

@@ -486,6 +486,29 @@ trace_spans_dropped = REGISTRY.counter(
     "(neither slowest-N, errored, nor the sample ring — "
     "docs/observability.md); phase totals still count them")
 
+# --- sharded control plane (runtime/leaderelection.py ShardMap,
+# runtime/store.py watch log / pagination; docs/benchmarks.md).
+shard_owner = REGISTRY.gauge(
+    "tpu_operator_shard_owner",
+    "1 while this replica holds the lease for control-plane shard "
+    "<shard> (tpu-operator-shard-<i>); 0 after a release or stepdown",
+    ["shard"])
+shard_reassignments = REGISTRY.counter(
+    "tpu_operator_shard_reassignments_total",
+    "Shard leases this replica took over from another holder (lease "
+    "transitions observed at acquire time — failover adoptions, not "
+    "first acquisitions)")
+watch_cache_hits = REGISTRY.counter(
+    "tpu_operator_watch_cache_hits_total",
+    "Watch registrations resumed from the store's per-kind event log "
+    "(resourceVersion known and still in the log) instead of a full "
+    "ADDED replay of every stored object", ["kind"])
+list_pages = REGISTRY.counter(
+    "tpu_operator_list_pages_total",
+    "Paginated list pages served from the store (continue-token keyset "
+    "walks; each page returns frozen snapshots, no payload deepcopy)",
+    ["kind"])
+
 # --- serving plane (tf_operator_tpu/serve; docs/serving.md SLO catalog).
 # Observed by the ServingEngine in whichever process runs it: each
 # serving replica exposes its own /metrics in production; benchmarks and

@@ -7,10 +7,13 @@ the in-process Store (create/get/list/update/update_status/delete/watch
 and friends), so the SDK, node agents, and the engine's controls run
 unchanged against an operator in another process or on another host.
 
-Watch is a streaming GET of JSON lines; on connection loss the watcher
-reconnects and the server replays current objects as ADDED — the informer
-relist contract, which every consumer in this codebase already treats as
-idempotent.
+Watch is a streaming GET of JSON lines. The watcher tracks the highest
+resourceVersion seen on the stream; on connection loss it reconnects
+with ``?resourceVersion=<last seen>`` and the server replays only the
+missed events from its watch log — no full ADDED storm. Only when the
+resume point has been evicted from the log does the server fall back to
+the informer relist contract (current objects replayed as ADDED), which
+every consumer in this codebase already treats as idempotent.
 """
 
 from __future__ import annotations
@@ -70,11 +73,14 @@ class RemoteWatcher:
                  handler: Callable[[str, object], None],
                  namespace: Optional[str] = None,
                  token: Optional[str] = None,
-                 ssl_context: Optional[ssl.SSLContext] = None):
-        self._url = f"{base_url}/apis/v1/watch/{kind}"
-        if namespace is not None:
-            self._url += "?" + urllib.parse.urlencode(
-                {"namespace": namespace})
+                 ssl_context: Optional[ssl.SSLContext] = None,
+                 since_rv: Optional[int] = None):
+        self._base = f"{base_url}/apis/v1/watch/{kind}"
+        self._namespace = namespace
+        # Highest resourceVersion seen on the stream; a reconnect
+        # resumes from it via the server's watch log, so a dropped
+        # connection no longer triggers a full ADDED replay.
+        self.last_rv: Optional[int] = since_rv
         self.kind = kind
         self.handler = handler
         self._token = token
@@ -86,6 +92,16 @@ class RemoteWatcher:
                                        name=f"watch-{kind}", daemon=True)
         self.thread.start()
 
+    def _watch_url(self) -> str:
+        params = {}
+        if self._namespace is not None:
+            params["namespace"] = self._namespace
+        if self.last_rv is not None:
+            params["resourceVersion"] = str(self.last_rv)
+        if not params:
+            return self._base
+        return self._base + "?" + urllib.parse.urlencode(params)
+
     def _loop(self) -> None:
         cls = WIRE_KINDS[self.kind]
         auth_failures = 0
@@ -93,7 +109,8 @@ class RemoteWatcher:
             try:
                 try:
                     resp = urllib.request.urlopen(
-                        _authed(self._url, self._token), context=self._ssl)
+                        _authed(self._watch_url(), self._token),
+                        context=self._ssl)
                 except urllib.error.HTTPError as e:
                     if e.code in (401, 403):
                         # NOT a transient blip: a misconfigured token
@@ -123,6 +140,9 @@ class RemoteWatcher:
                         continue  # keepalive
                     evt = json.loads(raw)
                     obj = cls.from_dict(evt["object"])
+                    rv = obj.metadata.resource_version
+                    if rv and (self.last_rv is None or rv > self.last_rv):
+                        self.last_rv = rv
                     try:
                         self.handler(evt["type"], obj)
                     except Exception:
@@ -270,6 +290,33 @@ class RemoteStore:
         cls = self._cls(kind)
         return [cls.from_dict(item) for item in data.get("items", [])]
 
+    def list_page(self, kind: str, namespace: Optional[str] = None,
+                  selector: Optional[Dict[str, str]] = None,
+                  limit: Optional[int] = None,
+                  after: Optional[Tuple[str, str]] = None):
+        """Store.list_page parity over the paginated list endpoint."""
+        from tf_operator_tpu.runtime.apiserver import (
+            decode_continue,
+            encode_continue,
+        )
+
+        query: Dict[str, str] = {}
+        if namespace is not None:
+            query["namespace"] = namespace
+        if selector:
+            query["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in sorted(selector.items()))
+        if limit is not None:
+            query["limit"] = str(limit)
+        if after is not None:
+            query["continue"] = encode_continue(after)
+        data = self._request("GET", f"/apis/v1/{kind}", query=query)
+        cls = self._cls(kind)
+        items = [cls.from_dict(item) for item in data.get("items", [])]
+        cont = data.get("continue") or ""
+        next_after = decode_continue(cont) if cont else None
+        return items, next_after, data.get("resourceVersion", 0)
+
     def list_claimable(self, kind: str, namespace: str,
                        selector: Dict[str, str],
                        owner_uid: str) -> List[object]:
@@ -319,12 +366,15 @@ class RemoteStore:
     # -- watch -------------------------------------------------------------
 
     def watch(self, kind: str, handler: Callable[[str, object], None],
-              replay: bool = True) -> RemoteWatcher:
-        # The server always replays current objects as ADDED on
-        # (re)connect; the replay flag exists for signature parity.
+              replay: bool = True,
+              since_rv: Optional[int] = None) -> RemoteWatcher:
+        # On first connect the server replays current objects as ADDED
+        # (or, with since_rv, only events newer than it); reconnects
+        # resume from the last resourceVersion seen on the stream.
         self._cls(kind)
         w = RemoteWatcher(self.base_url, kind, handler,
-                          token=self.token, ssl_context=self._ssl)
+                          token=self.token, ssl_context=self._ssl,
+                          since_rv=since_rv)
         with self._lock:
             self._watchers.append(w)
         return w

@@ -9,9 +9,13 @@ control loop runs hermetically:
   concurrency on update;
 - label-selector list;
 - watch: registered handlers receive (ADDED/MODIFIED/DELETED, object)
-  callbacks on a dispatcher thread per watcher (informer analog — objects
-  are deep-copied both ways, preserving the informer-cache immutability
-  discipline the reference relies on, controller.go:325).
+  callbacks on a dispatcher thread per watcher (informer analog). Every
+  event is deepcopied ONCE and that snapshot is shared by all watchers
+  — handlers must not mutate delivered objects (the informer-cache
+  immutability discipline the reference relies on, controller.go:325).
+  A per-kind watch log lets reconnecting watchers resume from a known
+  resourceVersion (``watch(since_rv=...)``) instead of replaying the
+  world as ADDED.
 
 Scale discipline (the reconcile hot path syncs ~1k jobs x ~10k pods):
 
@@ -29,8 +33,9 @@ Scale discipline (the reconcile hot path syncs ~1k jobs x ~10k pods):
 
 from __future__ import annotations
 
+import collections
+import copy
 import datetime as _dt
-import itertools
 import queue
 import threading
 import uuid
@@ -39,6 +44,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+
+# Per-kind watch-log capacity: a reconnecting watcher with a known
+# resourceVersion replays deltas from this ring (watch-cache hit); a
+# resume point older than the ring's tail falls back to the full ADDED
+# replay. Sized for reconnect windows (seconds of events), not history.
+WATCH_LOG_CAPACITY = 4096
 
 # The label both indexes and the controller's base selector key on
 # (api/constants.LABEL_JOB_NAME; duplicated literally — the store must
@@ -121,11 +132,36 @@ class Store:
         # kind -> {(namespace, name) -> obj}
         self._objects: Dict[str, Dict[Tuple[str, str], object]] = {}
         self._watchers: List[Watcher] = []
-        self._rv = itertools.count(1)
+        # Last-assigned resourceVersion (plain int, not an iterator, so
+        # latest_rv() can answer without consuming one).
+        self._rv = 0
         # (kind, namespace, job-name label) -> {(ns, name), ...}
         self._label_index: Dict[Tuple[str, str, str], set] = {}
         # (kind, controller-owner uid) -> {(ns, name), ...}
         self._owner_index: Dict[Tuple[str, str], set] = {}
+        # kind -> deque[(event rv, event type, frozen stored object)]:
+        # the watch cache. Appended under the lock by every write;
+        # watch(since_rv=...) replays deltas from it.
+        self._watch_log: Dict[str, collections.deque] = {}
+        # kind -> highest event rv ever evicted from the log (a resume
+        # at or before this point has a gap -> full replay).
+        self._watch_log_evicted: Dict[str, int] = {}
+        # Plain-int mirrors of the watch-cache/pagination metrics, for
+        # benches and tests that read the store without scraping the
+        # registry (the registry is process-global and shared).
+        self.watch_cache_hits = 0
+        self.watch_cache_misses = 0
+        self.list_pages = 0
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def latest_rv(self) -> int:
+        """Highest resourceVersion assigned so far (0 = no writes yet):
+        the resume point a watcher passes back as ``since_rv``."""
+        with self._lock:
+            return self._rv
 
     # -- indexes (maintained under the lock on every write) ---------------
 
@@ -162,17 +198,22 @@ class Store:
             key = (obj.metadata.namespace, obj.metadata.name)
             if key in coll:
                 raise AlreadyExistsError(f"{kind} {key} already exists")
-            obj = obj.deepcopy()
+            # Identity is stamped on the CALLER's object and a deepcopy
+            # becomes the stored snapshot — one copy per create (this
+            # used to copy twice: once in, once back out). The return
+            # value stays caller-owned and mutable; the store never
+            # retains a reference to it.
             if not obj.metadata.uid:
                 obj.metadata.uid = str(uuid.uuid4())
             if obj.metadata.creation_timestamp is None:
                 obj.metadata.creation_timestamp = _dt.datetime.now(
                     _dt.timezone.utc)
-            obj.metadata.resource_version = next(self._rv)
-            coll[key] = obj
-            self._index_add(kind, key, obj)
-            self._notify(kind, ADDED, obj)
-            return obj.deepcopy()
+            obj.metadata.resource_version = self._next_rv()
+            stored = obj.deepcopy()
+            coll[key] = stored
+            self._index_add(kind, key, stored)
+            self._notify(kind, ADDED, stored)
+            return obj
 
     def get(self, kind: str, namespace: str, name: str) -> object:
         with self._lock:
@@ -180,6 +221,15 @@ class Store:
                 return self._objects[kind][(namespace, name)].deepcopy()
             except KeyError:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
+
+    def get_snapshot(self, kind: str, namespace: str, name: str):
+        """The stored object itself — FROZEN — or None. The zero-copy
+        point read: stored objects are never mutated in place (every
+        write replaces the slot), so the snapshot stays valid forever;
+        the caller must treat it as immutable and ``deepcopy()`` before
+        mutating (the ``list_claimable`` contract, for a single key)."""
+        with self._lock:
+            return self._objects.get(kind, {}).get((namespace, name))
 
     def try_get(self, kind: str, namespace: str, name: str):
         try:
@@ -281,15 +331,19 @@ class Store:
                     f"{kind} {key}: resourceVersion "
                     f"{obj.metadata.resource_version} != "
                     f"{current.metadata.resource_version}")
-            obj = obj.deepcopy()
+            # Same one-copy discipline as create: stamp the caller's
+            # object, store a deepcopy, hand the caller's own object
+            # back (its resourceVersion now current, so a follow-up
+            # CAS write passes without a re-read).
             obj.metadata.uid = current.metadata.uid
             obj.metadata.creation_timestamp = current.metadata.creation_timestamp
-            obj.metadata.resource_version = next(self._rv)
+            obj.metadata.resource_version = self._next_rv()
+            stored = obj.deepcopy()
             self._index_remove(kind, key, current)
-            coll[key] = obj
-            self._index_add(kind, key, obj)
-            self._notify(kind, MODIFIED, obj)
-            return obj.deepcopy()
+            coll[key] = stored
+            self._index_add(kind, key, stored)
+            self._notify(kind, MODIFIED, stored)
+            return obj
 
     def update_status(self, kind: str, obj) -> object:
         """Status-subresource-style update: merges only .status (and
@@ -300,14 +354,26 @@ class Store:
             current = coll.get(key)
             if current is None:
                 raise NotFoundError(f"{kind} {key} not found")
-            stored = current.deepcopy()
+            # Zero-copy merge: the new stored snapshot SHARES the
+            # current one's frozen spec (neither is ever mutated in
+            # place); only .status — the part that changed — is
+            # deepcopied. This is the hottest write in the system (one
+            # per kubelet phase transition and one per controller
+            # sync), and it used to deepcopy the whole object twice
+            # plus the status. The caller's resourceVersion is synced
+            # in place so its working copy stays current; the return
+            # is the FROZEN stored snapshot (callers treat it as
+            # immutable, like every other snapshot read).
+            stored = copy.copy(current)
+            stored.metadata = copy.copy(current.metadata)
             stored.status = obj.status.deepcopy()
-            stored.metadata.resource_version = next(self._rv)
+            stored.metadata.resource_version = self._next_rv()
+            obj.metadata.resource_version = stored.metadata.resource_version
             # No index maintenance: a status merge cannot change the
             # labels/ownerRefs the (key-valued) indexes are built from.
             coll[key] = stored
             self._notify(kind, MODIFIED, stored)
-            return stored.deepcopy()
+            return stored
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
@@ -316,7 +382,15 @@ class Store:
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
             self._index_remove(kind, (namespace, name), obj)
-            self._notify(kind, DELETED, obj)
+            # The DELETED event carries a fresh resourceVersion (on a
+            # shallow tombstone — the popped snapshot stays frozen) so
+            # resumed watchers order the delete after the object's last
+            # modification and reconnecting clients can advance their
+            # resume point past it.
+            tomb = copy.copy(obj)
+            tomb.metadata = copy.copy(obj.metadata)
+            tomb.metadata.resource_version = self._next_rv()
+            self._notify(kind, DELETED, tomb)
 
     def try_delete(self, kind: str, namespace: str, name: str) -> bool:
         try:
@@ -337,17 +411,75 @@ class Store:
             return [(ns, name, obj.metadata.resource_version)
                     for (ns, name), obj in self._objects.get(kind, {}).items()]
 
+    def list_page(self, kind: str, namespace: Optional[str] = None,
+                  selector: Optional[Dict[str, str]] = None,
+                  limit: Optional[int] = None,
+                  after: Optional[Tuple[str, str]] = None):
+        """One page of a keyset-paginated list. Returns
+        ``(items, next_after, rv)``: items sorted by (namespace, name)
+        strictly after the ``after`` cursor, at most ``limit`` of them;
+        feed ``next_after`` back as ``after`` to continue (None = walk
+        complete); ``rv`` is the store's resourceVersion when the page
+        was cut. The strictly-increasing key cursor makes a page walk
+        exactly-once for every object that exists for its whole
+        duration, regardless of concurrent writes between pages. Items
+        are FROZEN stored snapshots — treat as immutable (serialize or
+        deepcopy, never mutate)."""
+        with self._lock:
+            self.list_pages += 1
+            from tf_operator_tpu.runtime import metrics
+
+            metrics.list_pages.inc(kind=kind)
+            coll = self._objects.get(kind, {})
+            items: List[object] = []
+            next_after = None
+            for key in sorted(coll):
+                if after is not None and key <= tuple(after):
+                    continue
+                obj = coll[key]
+                if namespace is not None and key[0] != namespace:
+                    continue
+                if selector and not matches_selector(obj.metadata.labels,
+                                                     selector):
+                    continue
+                items.append(obj)
+                if limit is not None and limit > 0 and len(items) >= limit:
+                    next_after = key
+                    break
+            return items, next_after, self._rv
+
     # -- watch ------------------------------------------------------------
 
     def watch(self, kind: str,
               handler: Callable[[str, object], None],
-              replay: bool = True) -> Watcher:
+              replay: bool = True,
+              since_rv: Optional[int] = None) -> Watcher:
         """Register a handler; with ``replay`` existing objects are
-        delivered as ADDED first (informer initial list)."""
+        delivered as ADDED first (informer initial list).
+
+        ``since_rv`` is the reconnect path: "I have seen every event up
+        to and including this resourceVersion". When the per-kind watch
+        log still covers that point, only the missed deltas replay, in
+        order (watch-cache hit — no ADDED storm); when the log has
+        evicted past it, the watcher falls back to the full ADDED
+        replay (miss — the reflector relist contract)."""
         with self._lock:
             w = Watcher(kind, handler)
             w._on_stop = self._remove_watcher
-            if replay:
+            replay_all = replay
+            if since_rv is not None:
+                if since_rv >= self._watch_log_evicted.get(kind, 0):
+                    self.watch_cache_hits += 1
+                    from tf_operator_tpu.runtime import metrics
+
+                    metrics.watch_cache_hits.inc(kind=kind)
+                    for entry_rv, et, obj in self._watch_log.get(kind, ()):
+                        if entry_rv > since_rv:
+                            w.queue.put((et, obj.deepcopy()))
+                    replay_all = False
+                else:
+                    self.watch_cache_misses += 1
+            if replay_all:
                 for obj in self._objects.get(kind, {}).values():
                     w.queue.put((ADDED, obj.deepcopy()))
             self._watchers.append(w)
@@ -367,9 +499,21 @@ class Store:
             w.stop()
 
     def _notify(self, kind: str, event_type: str, obj) -> None:
+        # Callers hold self._lock. The frozen stored object lands in
+        # the watch log (no copy — it is immutable); live watchers all
+        # receive ONE shared deepcopy per event instead of one each
+        # (handlers already must not mutate delivered objects; at fan-
+        # out degree W this was W deepcopies per write).
+        wlog = self._watch_log.setdefault(kind, collections.deque())
+        wlog.append((obj.metadata.resource_version, event_type, obj))
+        while len(wlog) > WATCH_LOG_CAPACITY:
+            self._watch_log_evicted[kind] = wlog.popleft()[0]
+        snap = None
         for w in self._watchers:
             if w.kind == kind:
-                w.queue.put((event_type, obj.deepcopy()))
+                if snap is None:
+                    snap = obj.deepcopy()
+                w.queue.put((event_type, snap))
 
 
 # Canonical collection names.
