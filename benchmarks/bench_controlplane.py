@@ -1664,7 +1664,9 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
                     crash_restarts: int = 1,
                     resync_period: float = 0.5,
                     profile=None,
-                    elastic: bool = False) -> Dict:
+                    elastic: bool = False,
+                    rl: bool = False,
+                    actors: int = 2) -> Dict:
     """Chaos scenario: the FULL control plane (gang admission +
     checkpoint barriers + disruptions) reconciling through a seeded
     ``FaultProfile`` (runtime/chaos.py) injected between the operator
@@ -1685,7 +1687,18 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
     shrinks through the faults — with three extra invariants sampled
     mid-resize: never below minSlices, admitted chips never above the
     budget at the per-group CURRENT size, and every shrink barrier
-    resolving acked|timeout."""
+    resolving acked|timeout.
+
+    ``rl=True`` switches to the heterogeneous-gang rounds
+    (hack/verify-chaos-invariants.py --rl): every job carries
+    ``actors`` explicit evict-class CPU-only actor replicas next to its
+    barrier-class learners, and the disruptor is an actor KILL STORM —
+    ``disruptions`` rounds, each deleting at least half of one job's
+    live actor pool, with no barrier and no displacement. Two extra
+    invariants are sampled throughout: a learner (world-member) pod's
+    uid never changes while its job runs — actor-only churn must never
+    restart the learner world — and the committed step never regresses
+    (docs/rl.md)."""
     from tf_operator_tpu.api.types import CheckpointPolicy
     from tf_operator_tpu.controller.ckpt import CheckpointCoordinator
     from tf_operator_tpu.controller.engine import EngineConfig
@@ -1809,6 +1822,17 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
                     "(committed steps lost across restart)")
             super()._start(pod)
 
+        def _publish(self, pod, progress, barrier, record_cls,
+                     status_cls) -> None:
+            # RL actors checkpoint nothing (docs/rl.md): an actor
+            # record would drag committed_step — the min over records —
+            # down to actor pace and poison every learner restore.
+            if (pod.metadata.labels.get(constants.LABEL_REPLICA_TYPE)
+                    == "actor"):
+                return
+            super()._publish(pod, progress, barrier, record_cls,
+                             status_cls)
+
     kubelet = _ChaosKubelet(base, steps=steps, tick=kubelet_tick,
                             admitted=group_admitted,
                             save_interval=save_interval)
@@ -1848,6 +1872,99 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
             except Exception:
                 pass  # injected fault; retry next tick
             stop_aux.wait(kubelet_tick)
+
+    storms = [0]
+    actor_kills = [0]
+    learner_uids: Dict[tuple, str] = {}
+    committed_seen: Dict[str, int] = {}
+
+    def actor_storm() -> None:
+        """The rl-round disruptor: round-robin over live jobs, each
+        storm deleting at least half the target's live actor pods in
+        one burst — no barrier, no displacement (evict-class
+        semantics). The engine recreates the pool; the learner world
+        must never notice."""
+        from tf_operator_tpu.runtime import metrics as metrics_mod
+
+        cursor = 0
+        half = max(1, (actors + 1) // 2)
+        while not stop_aux.is_set() and storms[0] < disruptions:
+            try:
+                live = sorted(
+                    j.metadata.name
+                    for j in base.list(store_mod.TPUJOBS,
+                                       namespace=NAMESPACE)
+                    if not cond.is_finished(j.status))
+                if not live:
+                    stop_aux.wait(kubelet_tick)
+                    continue
+                target = live[cursor % len(live)]
+                cursor += 1
+                pool = sorted(
+                    (p for p in base.list(
+                        store_mod.PODS, namespace=NAMESPACE,
+                        selector={constants.LABEL_JOB_NAME: target})
+                     if p.metadata.labels.get(
+                         constants.LABEL_REPLICA_TYPE) == "actor"
+                     and p.status.phase not in ("Succeeded", "Failed")),
+                    key=lambda p: p.metadata.name)
+                if len(pool) < half:
+                    stop_aux.wait(kubelet_tick)
+                    continue  # pool not (re)grown yet; storm a whole one
+                for p in pool[:half]:
+                    if base.try_delete(store_mod.PODS, NAMESPACE,
+                                       p.metadata.name):
+                        actor_kills[0] += 1
+                        metrics_mod.actor_preemptions.inc(
+                            job_namespace=NAMESPACE, reason="chaos")
+                storms[0] += 1
+            except Exception:
+                pass  # racing convergence; retry next tick
+            stop_aux.wait(kubelet_tick)
+
+    def sample_rl() -> None:
+        """The rl-round invariants, sampled against the BASE store:
+        (1) a learner (non-actor) pod's uid never changes while its job
+        runs — actor-only churn restarting the learner world is THE
+        regression this mode exists to catch; (2) the committed step
+        (min over the job's CheckpointRecords) never regresses."""
+        while not stop_aux.wait(0.05):
+            finished = {j.metadata.name
+                        for j in base.list(store_mod.TPUJOBS,
+                                           namespace=NAMESPACE)
+                        if cond.is_finished(j.status)}
+            for p in base.list(store_mod.PODS, namespace=NAMESPACE):
+                if p.status.phase != "Running":
+                    continue
+                labels = p.metadata.labels
+                jn = labels.get(constants.LABEL_JOB_NAME, "")
+                rt = labels.get(constants.LABEL_REPLICA_TYPE, "")
+                if jn in finished or rt == "actor":
+                    continue
+                ident = (jn, rt,
+                         labels.get(constants.LABEL_REPLICA_INDEX, ""))
+                prev = learner_uids.get(ident)
+                if prev is None:
+                    learner_uids[ident] = p.metadata.uid
+                elif prev != p.metadata.uid:
+                    learner_uids[ident] = p.metadata.uid
+                    violations.append(
+                        f"learner pod {ident} restarted (uid changed) "
+                        "during actor-only chaos")
+            steps_by_job: Dict[str, List[int]] = {}
+            for r in base.list(store_mod.CHECKPOINTRECORDS,
+                               namespace=NAMESPACE):
+                jn = r.metadata.labels.get(constants.LABEL_JOB_NAME, "")
+                if r.status.step >= 0 and jn not in finished:
+                    steps_by_job.setdefault(jn, []).append(r.status.step)
+            for jn, ss in steps_by_job.items():
+                committed = min(ss)
+                prev = committed_seen.get(jn)
+                if prev is not None and committed < prev:
+                    violations.append(
+                        f"job {jn} committed step regressed {prev} -> "
+                        f"{committed} under actor-only chaos")
+                committed_seen[jn] = max(prev or 0, committed)
 
     def disrupt() -> None:
         """Round-robin planned disruptions through the (current)
@@ -1953,10 +2070,13 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
 
     build()
     kubelet.start()
-    aux_specs = [(disrupt, "disruptor"), (resync, "resync"),
+    aux_specs = [(actor_storm if rl else disrupt, "disruptor"),
+                 (resync, "resync"),
                  (sample_admission, "admission-probe")]
     if elastic:
         aux_specs.append((exercise_resizes, "resize-exerciser"))
+    if rl:
+        aux_specs.append((sample_rl, "rl-probe"))
     aux = [threading.Thread(target=fn, daemon=True, name=name)
            for fn, name in aux_specs]
     t0 = time.perf_counter()
@@ -1968,8 +2088,20 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
             # both; the non-elastic shape keeps the historical
             # `workers` fan-out.
             job = testutil.new_tpujob(worker=1 if elastic else workers,
+                                      actor=actors if rl else 0,
                                       name=f"bench-{i:04d}",
                                       namespace=NAMESPACE)
+            if rl:
+                from tf_operator_tpu.api.types import (
+                    DisruptionClass,
+                    ReplicaType,
+                    RolePolicy,
+                )
+
+                job.spec.replica_specs[ReplicaType.ACTOR].role_policy = \
+                    RolePolicy(chip_consuming=False, preemptible=True,
+                               min_replicas=1, max_replicas=actors,
+                               disruption_class=DisruptionClass.EVICT)
             job.spec.slice.accelerator = f"v5e-{chips_per_job}"
             if elastic:
                 job.spec.slice.min_slices = 1
@@ -2094,7 +2226,434 @@ def run_chaos_bench(jobs: int, workers: int, threadiness: int,
         "max_admitted_chips": max_admitted[0],
         "elastic": elastic,
         "shrinks_landed": shrinks_landed[0],
+        "rl": rl,
+        "actors_per_job": actors if rl else 0,
+        "actor_kill_storms": storms[0],
+        "actor_kills": actor_kills[0],
+        "learner_identities_tracked": len(learner_uids),
         "invariant_violations": violations,
+    }
+
+
+class RLWorldKubelet(threading.Thread):
+    """Fake data plane for the RL actor–learner scenario: one training
+    WORLD per job plus a free-floating actor pool, with membership
+    derived from the POD SHAPE, not the role name — a pod whose default
+    container carries ``JAX_PROCESS_ID`` joined the ranked
+    jax.distributed world (bootstrap/cluster.py renders it only for
+    ranked types); a pod without it (an RL actor) did not.
+
+    Per tick, a job whose world members are ALL Running advances the
+    job's step counter by one and charges one tick to the executed
+    counter; world members publish CheckpointRecords on the periodic
+    cadence. A world member that (re)starts with ``TPUJOB_RESTORE_STEP``
+    rolls the WHOLE world back to that committed step — the re-executed
+    steps are the honest waste of a world restart. Actor pods start,
+    run, and die without touching any of that, which is exactly the
+    asymmetry the goodput comparison measures:
+
+        goodput_ratio = useful steps / total steps executed."""
+
+    def __init__(self, store: Store, steps: int, tick: float = 0.01,
+                 admitted=None, save_interval: int = 20):
+        super().__init__(name="rl-kubelet", daemon=True)
+        self.store = store
+        self.steps = steps
+        self.tick = tick
+        self.admitted = admitted
+        self.save_interval = save_interval
+        self.progress: Dict[str, int] = {}    # job -> useful steps
+        self.executed: Dict[str, int] = {}    # job -> ticks advanced
+        self.last_save: Dict[str, int] = {}
+        self.world_size: Dict[str, int] = {}  # max world members seen
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    @staticmethod
+    def is_world_member(pod) -> bool:
+        """Shape-derived world membership: the ranked-bootstrap env is
+        present iff the role joined the jax.distributed world."""
+        return any("JAX_PROCESS_ID" in c.env for c in pod.spec.containers)
+
+    def run(self) -> None:
+        from tf_operator_tpu.api.types import (
+            CheckpointRecord,
+            CheckpointRecordStatus,
+        )
+
+        while not self._stop.is_set():
+            by_job: Dict[str, list] = {}
+            for p in self.store.list(store_mod.PODS, namespace=NAMESPACE):
+                if p.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                    continue
+                jn = p.metadata.labels.get(constants.LABEL_JOB_NAME, "")
+                by_job.setdefault(jn, []).append(p)
+            for jn, pods in by_job.items():
+                self._drive(jn, pods, CheckpointRecord,
+                            CheckpointRecordStatus)
+            self._stop.wait(self.tick)
+
+    def _drive(self, job_name: str, pods, record_cls, status_cls) -> None:
+        world = [p for p in pods if self.is_world_member(p)]
+        for p in pods:
+            if p.status.phase == PodPhase.PENDING:
+                if (self.admitted is not None
+                        and not self.admitted(p.metadata.namespace,
+                                              job_name)):
+                    continue
+                self._start(p, job_name)
+        running = [p for p in world if p.status.phase == PodPhase.RUNNING]
+        idents = {(p.metadata.labels.get(constants.LABEL_REPLICA_TYPE),
+                   p.metadata.labels.get(constants.LABEL_REPLICA_INDEX))
+                  for p in world}
+        self.world_size[job_name] = max(self.world_size.get(job_name, 0),
+                                        len(idents))
+        if job_name not in self.progress:
+            return
+        if (not running or len(running) != self.world_size[job_name]
+                or len(world) != len(running)):
+            return  # world incomplete: training paused, no steps burn
+        progress = self.progress[job_name] + 1
+        self.progress[job_name] = progress
+        self.executed[job_name] = self.executed.get(job_name, 0) + 1
+        if (progress - self.last_save.get(job_name, 0) >= self.save_interval
+                or progress >= self.steps):
+            self.last_save[job_name] = progress
+            for p in running:
+                self._publish(p, progress, record_cls, status_cls)
+        if progress >= self.steps:
+            for p in pods:  # actors included: the episode is over
+                patch = Pod(metadata=ObjectMeta(
+                    name=p.metadata.name,
+                    namespace=p.metadata.namespace))
+                patch.status = PodStatus(
+                    phase=PodPhase.SUCCEEDED, start_time=testutil.now(),
+                    container_statuses=[ContainerStatus(
+                        name=constants.DEFAULT_CONTAINER_NAME,
+                        state="Terminated", exit_code=0)])
+                try:
+                    self.store.update_status(store_mod.PODS, patch)
+                except (store_mod.NotFoundError, store_mod.ConflictError):
+                    pass
+
+    def _start(self, pod, job_name: str) -> None:
+        if self.is_world_member(pod):
+            restore = None
+            for c in pod.spec.containers:
+                if constants.ENV_RESTORE_STEP in c.env:
+                    restore = int(c.env[constants.ENV_RESTORE_STEP])
+            if restore is not None:
+                # World restart: everyone resumes from the committed
+                # step; uncommitted progress past the last save is
+                # re-executed (counted against goodput).
+                self.progress[job_name] = restore
+                self.last_save[job_name] = restore
+            else:
+                self.progress.setdefault(job_name, 0)
+        patch = Pod(metadata=ObjectMeta(name=pod.metadata.name,
+                                        namespace=pod.metadata.namespace))
+        patch.status = PodStatus(phase=PodPhase.RUNNING,
+                                 start_time=testutil.now())
+        try:
+            self.store.update_status(store_mod.PODS, patch)
+        except (store_mod.NotFoundError, store_mod.ConflictError):
+            pass
+
+    def _publish(self, pod, step: int, record_cls, status_cls) -> None:
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        status = status_cls(step=step, progress_step=step,
+                            directory="/bench/ckpt", save_seconds=0.001,
+                            updated_at=testutil.now())
+        try:
+            existing = self.store.try_get(store_mod.CHECKPOINTRECORDS,
+                                          ns, name)
+            if existing is None:
+                self.store.create(store_mod.CHECKPOINTRECORDS, record_cls(
+                    metadata=ObjectMeta(
+                        name=name, namespace=ns,
+                        labels=dict(pod.metadata.labels),
+                        owner_references=[r.deepcopy() for r in
+                                          pod.metadata.owner_references]),
+                    status=status))
+            else:
+                existing.status = status
+                self.store.update_status(store_mod.CHECKPOINTRECORDS,
+                                         existing)
+        except (store_mod.AlreadyExistsError, store_mod.ConflictError,
+                store_mod.NotFoundError):
+            pass
+
+
+def _rl_once(heterogeneous: bool, learners: int, actors: int,
+             threadiness: int, timeout: float, steps: int,
+             save_interval: int, kill_rounds: int,
+             kubelet_tick: float) -> Dict:
+    """One RL sub-run. ``heterogeneous=True`` is the role-policy shape:
+    ``learners`` barrier-class workers plus an explicit evict-class
+    CPU-only actor pool. False is the homogeneous control: the SAME
+    headcount, but the actor slots are plain workers — world members —
+    so every kill storm is a world restart. Same kill schedule both
+    ways; the goodput gap is the subsystem's value."""
+    from tf_operator_tpu.api.types import (
+        CheckpointPolicy,
+        DisruptionClass,
+        ReplicaType,
+        RolePolicy,
+    )
+    from tf_operator_tpu.controller.ckpt import CheckpointCoordinator
+    from tf_operator_tpu.controller.engine import EngineConfig
+    from tf_operator_tpu.controller.gang import (
+        PHASE_INQUEUE,
+        PHASE_RUNNING,
+        SliceGangScheduler,
+    )
+    from tf_operator_tpu.runtime import metrics
+
+    store = Store()
+    ckpt = CheckpointCoordinator(store).start()
+    gang = SliceGangScheduler(store, total_chips=None, ckpt=ckpt)
+    ckpt.on_ack = gang.readmit
+    controller = TPUJobController(
+        store, config=EngineConfig(enable_gang_scheduling=True),
+        gang=gang, namespace=NAMESPACE, ckpt=ckpt)
+
+    def group_admitted(ns: str, job_name: str) -> bool:
+        g = store.try_get(store_mod.SLICEGROUPS, ns, job_name)
+        return g is not None and g.status.phase in (PHASE_INQUEUE,
+                                                    PHASE_RUNNING)
+
+    kubelet = RLWorldKubelet(store, steps=steps, tick=kubelet_tick,
+                             admitted=group_admitted,
+                             save_interval=save_interval)
+    name = "bench-rl-0000"
+    metrics.job_goodput_ratio.set(0.0, job_namespace=NAMESPACE, job=name)
+    metrics.learner_goodput_ratio.set(0.0, job_namespace=NAMESPACE,
+                                      job=name)
+    if heterogeneous:
+        job = testutil.new_tpujob(worker=learners, actor=actors,
+                                  name=name, namespace=NAMESPACE)
+        job.spec.replica_specs[ReplicaType.ACTOR].role_policy = RolePolicy(
+            chip_consuming=False, preemptible=True,
+            min_replicas=1, max_replicas=actors,
+            disruption_class=DisruptionClass.EVICT)
+        metrics.actor_pool_replicas.set(actors, job_namespace=NAMESPACE,
+                                        job=name, replica_type="actor")
+    else:
+        job = testutil.new_tpujob(worker=learners + actors, name=name,
+                                  namespace=NAMESPACE)
+    job.spec.slice.accelerator = "v5e-4"
+    job.spec.run_policy.checkpoint_policy = CheckpointPolicy(
+        enabled=True, directory="/bench/ckpt",
+        interval_steps=save_interval)
+    violations: List[str] = []
+    kills = [0]
+    rounds_done = [0]
+    stop_aux = threading.Event()
+
+    def kill_targets():
+        """Live pods the storm may kill: the actor pool in the
+        heterogeneous run; the same POSITIONS (worker index >=
+        learners) in the homogeneous control."""
+        out = []
+        for p in store.list(store_mod.PODS, namespace=NAMESPACE,
+                            selector={constants.LABEL_JOB_NAME: name}):
+            if p.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            rt = p.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "")
+            idx = int(p.metadata.labels.get(
+                constants.LABEL_REPLICA_INDEX, "0"))
+            if heterogeneous:
+                if rt == ReplicaType.ACTOR:
+                    out.append(p)
+            elif rt == ReplicaType.WORKER and idx >= learners:
+                out.append(p)
+        return sorted(out, key=lambda p: p.metadata.name)
+
+    def storm() -> None:
+        """The actor kill storm: ``kill_rounds`` rounds, each deleting
+        at least half the pool at once — paced to land deep into the
+        save window (>=75% of the interval uncommitted) so a world
+        restart provably wastes work, and gated on the pool being whole
+        again so every round hits a healed pool."""
+        while not stop_aux.is_set() and rounds_done[0] < kill_rounds:
+            prog = kubelet.progress.get(name, 0)
+            if prog >= steps:
+                break
+            saved = kubelet.last_save.get(name, 0)
+            window = prog - saved
+            # Only storm a fully-RUNNING pool (each round hits a healed
+            # world), only after the first committed save exists (or a
+            # control-run restart has nothing to roll back to), and
+            # only deep into the save window (>=75% uncommitted) so a
+            # world restart provably wastes work.
+            targets = [p for p in kill_targets()
+                       if p.status.phase == PodPhase.RUNNING]
+            if (saved <= 0 or window < int(save_interval * 0.75)
+                    or len(targets) < actors):
+                stop_aux.wait(kubelet_tick)
+                continue
+            for p in targets[:max(1, (actors + 1) // 2)]:
+                if store.try_delete(store_mod.PODS, NAMESPACE,
+                                    p.metadata.name):
+                    kills[0] += 1
+                    if heterogeneous:
+                        metrics.actor_preemptions.inc(
+                            job_namespace=NAMESPACE, reason="manual")
+            rounds_done[0] += 1
+            stop_aux.wait(kubelet_tick)
+
+    # Learner (world-member) incarnations: identity -> uid first seen
+    # Running. In the heterogeneous run a CHANGED uid is a violation —
+    # actor churn must never restart the learner world. The control run
+    # kills world members on purpose, so it only reports the count.
+    world_uids: Dict[tuple, str] = {}
+    learner_restarts = [0]
+    committed_seen = [None]
+
+    def probe() -> None:
+        while not stop_aux.wait(0.02):
+            for p in store.list(store_mod.PODS, namespace=NAMESPACE,
+                                selector={constants.LABEL_JOB_NAME: name}):
+                if p.status.phase != PodPhase.RUNNING:
+                    continue
+                if not RLWorldKubelet.is_world_member(p):
+                    continue
+                ident = (p.metadata.labels.get(
+                    constants.LABEL_REPLICA_TYPE),
+                    p.metadata.labels.get(constants.LABEL_REPLICA_INDEX))
+                prev = world_uids.get(ident)
+                if prev is None:
+                    world_uids[ident] = p.metadata.uid
+                elif prev != p.metadata.uid:
+                    learner_restarts[0] += 1
+                    world_uids[ident] = p.metadata.uid
+                    if heterogeneous:
+                        violations.append(
+                            f"learner pod {ident} restarted (uid "
+                            f"changed) during actor-only kill storms")
+            records = [r.status.step for r in store.list(
+                store_mod.CHECKPOINTRECORDS, namespace=NAMESPACE,
+                selector={constants.LABEL_JOB_NAME: name})
+                if r.status.step >= 0]
+            if records:
+                committed = min(records)
+                prev = committed_seen[0]
+                if prev is not None and committed < prev:
+                    violations.append(
+                        f"committed step regressed {prev} -> "
+                        f"{committed} under the kill storm")
+                committed_seen[0] = max(prev or 0, committed)
+
+    controller.run(threadiness=threadiness)
+    kubelet.start()
+    storm_t = threading.Thread(target=storm, daemon=True, name="storm")
+    probe_t = threading.Thread(target=probe, daemon=True, name="rl-probe")
+    t0 = time.perf_counter()
+    try:
+        store.create(store_mod.TPUJOBS, job)
+        storm_t.start()
+        probe_t.start()
+        deadline = t0 + timeout
+        while True:
+            succeeded = sum(store.project(
+                store_mod.TPUJOBS,
+                lambda j: 1 if cond.is_succeeded(j.status) else None,
+                namespace=NAMESPACE))
+            if succeeded >= 1:
+                # Converged. Kill rounds are best-effort past this
+                # point (no live pool left to storm) — the artifact
+                # reports how many actually landed.
+                break
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"job not Succeeded after {timeout}s "
+                    f"({rounds_done[0]}/{kill_rounds} kill rounds, "
+                    f"step {kubelet.progress.get(name, 0)}/{steps})")
+            time.sleep(0.02)
+        convergence = time.perf_counter() - t0
+    finally:
+        stop_aux.set()
+        kubelet.stop()
+        controller.stop()
+        ckpt.stop()
+        store.stop_watchers()
+
+    # Pod-shape evidence, from the store's final state: actor pods must
+    # hold no chips, no ranked env, and a learner-endpoints env; the
+    # control run has no such pods.
+    for p in store.list(store_mod.PODS, namespace=NAMESPACE,
+                        selector={constants.LABEL_JOB_NAME: name}):
+        rt = p.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "")
+        if rt != "actor":
+            continue
+        if any(constants.RESOURCE_TPU in c.resources
+               for c in p.spec.containers):
+            violations.append(
+                f"actor pod {p.metadata.name} was stamped with "
+                f"{constants.RESOURCE_TPU} resources")
+        if RLWorldKubelet.is_world_member(p):
+            violations.append(
+                f"actor pod {p.metadata.name} carries ranked world env")
+        if not any(constants.ENV_LEARNER_ENDPOINTS in c.env
+                   for c in p.spec.containers):
+            violations.append(
+                f"actor pod {p.metadata.name} missing "
+                f"{constants.ENV_LEARNER_ENDPOINTS}")
+
+    executed = kubelet.executed.get(name, 0)
+    useful = min(steps, kubelet.progress.get(name, 0))
+    return {
+        "heterogeneous": heterogeneous,
+        "convergence_seconds": round(convergence, 3),
+        "steps": steps,
+        "steps_executed": executed,
+        "goodput_ratio": round(useful / executed, 4) if executed else 0.0,
+        "kill_rounds": rounds_done[0],
+        "kills": kills[0],
+        "learner_restarts": learner_restarts[0],
+        "committed_step_final": committed_seen[0],
+        "learner_goodput_ratio_metric": round(
+            metrics.learner_goodput_ratio.value(
+                job_namespace=NAMESPACE, job=name), 4),
+        "invariant_violations": violations,
+    }
+
+
+def run_rl_bench(learners: int, actors: int, threadiness: int,
+                 timeout: float, save_interval: int = 20,
+                 kill_rounds: int = 6,
+                 kubelet_tick: float = 0.01) -> Dict:
+    """RL actor–learner scenario (--rl, docs/rl.md): the SAME fleet
+    shape and kill schedule run twice — once as a heterogeneous gang
+    (barrier-class learners + an explicit evict-class CPU-only actor
+    pool) and once as the homogeneous control (the actor slots are
+    plain workers). Each kill round deletes at least half the pool
+    mid-save-window. In the heterogeneous run the learner world must
+    not notice (uid-stable learners, committed step monotonic, goodput
+    ~1.0); the control run pays a world restart per round — the
+    learner-goodput gap is the headline."""
+    steps = (kill_rounds + 2) * save_interval
+    control = _rl_once(False, learners, actors, threadiness, timeout,
+                       steps, save_interval, kill_rounds, kubelet_tick)
+    rl = _rl_once(True, learners, actors, threadiness, timeout,
+                  steps, save_interval, kill_rounds, kubelet_tick)
+    return {
+        "learners": learners,
+        "actors": actors,
+        "kill_rounds": kill_rounds,
+        "steps_per_run": steps,
+        "save_interval_steps": save_interval,
+        "threadiness": threadiness,
+        "learner_goodput_ratio_rl": rl["goodput_ratio"],
+        "learner_goodput_ratio_control": control["goodput_ratio"],
+        "goodput_gap": round(
+            rl["goodput_ratio"] - control["goodput_ratio"], 4),
+        "rl": rl,
+        "control": control,
+        "invariant_violations": list(rl["invariant_violations"])
+        + list(control["invariant_violations"]),
     }
 
 
@@ -2194,6 +2753,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "invariants (never below minSlices, budget "
                         "held mid-resize, every shrink barrier "
                         "resolved) are checked")
+    p.add_argument("--rl", action="store_true",
+                   help="switches to the RL actor–learner scenario "
+                        "(docs/rl.md): one heterogeneous gang "
+                        "(barrier-class learners + an explicit "
+                        "evict-class CPU-only actor pool) and one "
+                        "homogeneous control with the same headcount, "
+                        "both under the same actor kill storms; the "
+                        "artifact reports learner goodput for each "
+                        "(acceptance: >=0.95 heterogeneous vs <=0.7 "
+                        "control) plus the learner-stability "
+                        "invariants")
+    p.add_argument("--learners", type=int, default=2,
+                   help="(--rl) barrier-class learner replicas")
+    p.add_argument("--actors", type=int, default=4,
+                   help="(--rl) actor-pool replicas")
+    p.add_argument("--kill-rounds", type=int, default=6,
+                   help="(--rl) kill storms; each deletes at least "
+                        "half the pool mid-save-window")
     p.add_argument("--oversubscribe", type=int, default=0,
                    help="N>0 switches to the elastic oversubscribe "
                         "scenario (docs/elastic.md): N tenants over a "
@@ -2232,6 +2809,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                        "chips_per_slice": args.chips_per_job})
         metric = (f"controlplane_oversubscribe_goodput_gain"
                   f"[{args.oversubscribe}t w{args.work_units}]")
+    elif args.rl:
+        config.update({"rl": True, "learners": args.learners,
+                       "actors": args.actors,
+                       "kill_rounds": args.kill_rounds,
+                       "save_interval": args.save_interval})
+        metric = (f"controlplane_rl_learner_goodput"
+                  f"[{args.learners}L+{args.actors}A "
+                  f"k{args.kill_rounds}]")
     elif args.chaos is not None:
         config.update({"chaos": args.chaos, "seed": args.chaos_seed,
                        "crash_restarts": args.crash_restarts,
@@ -2265,6 +2850,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 chips_per_slice=args.chips_per_job,
                 work_units=args.work_units, stagger=args.stagger,
                 kubelet_tick=args.kubelet_tick)
+        elif args.rl:
+            result = run_rl_bench(
+                args.learners, args.actors, args.threadiness,
+                args.timeout, save_interval=args.save_interval,
+                kill_rounds=args.kill_rounds,
+                kubelet_tick=args.kubelet_tick)
         elif args.chaos is not None:
             result = run_chaos_bench(
                 args.jobs, args.workers, args.threadiness, args.timeout,
@@ -2291,6 +2882,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                trace=args.trace)
         if args.oversubscribe > 0:
             value, unit = result["goodput_gain_pct"], "percent"
+        elif args.rl:
+            value, unit = result["learner_goodput_ratio_rl"], "ratio"
         elif args.disruptions > 0:
             value, unit = result.get("goodput_ratio_mean"), "ratio"
         else:

@@ -24,7 +24,10 @@ types (required containers, replica bounds, name formats) live in
 quota kinds (cohort semantics, borrowing, reclaim) are documented in
 `docs/quota.md`; the CheckpointRecord kind (the save-before-evict
 barrier's ack channel) in `docs/checkpoint.md`; the `serving` replica
-role and ServingPolicy (online-inference gangs) in `docs/serving.md`.
+role and ServingPolicy (online-inference gangs) in `docs/serving.md`;
+the per-role RolePolicy (heterogeneous actor–learner gangs, the
+`actor` replica type, disruption classes, elastic replica bands) in
+`docs/rl.md`.
 """
 
 

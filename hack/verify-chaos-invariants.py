@@ -171,6 +171,50 @@ def run_shard_round(seed: int, timeout: float = 120.0,
             + list(result["invariant_violations"]))
 
 
+def run_rl_round(seed: int, timeout: float = 120.0,
+                 verbose: bool = False) -> List[str]:
+    """One randomized HETEROGENEOUS-GANG round (--rl): every job
+    carries an explicit evict-class CPU-only actor pool next to its
+    barrier-class learners, reconciles through the drawn fault profile
+    with one operator crash-restart, and the disruptor is an actor
+    KILL STORM (at least half of a job's pool deleted per round, no
+    barrier, no displacement). Violations returned ([] = clean):
+
+      * a learner (world-member) pod's uid changed while its job ran —
+        actor-only churn restarted the learner world;
+      * a job's committed step regressed under the storm;
+      * orphaned pods / duplicate live pod identities / capacity
+        breaches / no convergence (the base invariants).
+
+    A NEW draw stream (separate function, not a run_round flag) so the
+    historical run_round seeds stay byte-identical."""
+    rng = random.Random(seed)
+    jobs = rng.randint(2, 4)
+    workers = rng.randint(2, 3)
+    actors = rng.randint(2, 4)
+    storms = rng.randint(1, 2)
+    profile = random_profile(rng, seed)
+    threadiness = rng.choice((2, 4))
+    try:
+        result = bench_controlplane.run_chaos_bench(
+            jobs=jobs, workers=workers, threadiness=threadiness,
+            timeout=timeout, seed=seed, profile=profile,
+            disruptions=storms, steps=30, save_interval=8,
+            barrier_timeout=8.0, crash_restarts=1,
+            resync_period=0.25, elastic=False, rl=True, actors=actors)
+    except TimeoutError as e:
+        return [f"no convergence under profile seed {seed} (rl): {e}"]
+    if verbose:
+        print(f"  seed {seed}: {jobs}x{workers}+{actors}a "
+              f"storms={result['actor_kill_storms']} "
+              f"kills={result['actor_kills']} "
+              f"faults={result['faults_injected_total']} "
+              f"retries={result['retries_total']} "
+              f"converged {result['convergence_seconds']}s",
+              file=sys.stderr)
+    return list(result["invariant_violations"])
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     p.add_argument("--rounds", type=int, default=10)
@@ -182,12 +226,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="run the sharded split-brain rounds (N shard "
                         "leases, two replicas, mid-run leader kill) "
                         "instead of the single-operator rounds")
+    p.add_argument("--rl", action="store_true",
+                   help="run the heterogeneous-gang rounds (explicit "
+                        "evict-class actor pools beside barrier-class "
+                        "learners, actor kill storms as the "
+                        "disruptor) instead of the single-operator "
+                        "rounds; checks the learner-incarnation and "
+                        "committed-step invariants (docs/rl.md)")
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args(argv)
     base = args.seed if args.seed is not None else \
         random.SystemRandom().randint(0, 2**31)
-    round_fn = run_shard_round if args.sharded else run_round
-    mode = "sharded " if args.sharded else ""
+    if args.sharded:
+        round_fn, mode = run_shard_round, "sharded "
+    elif args.rl:
+        round_fn, mode = run_rl_round, "rl "
+    else:
+        round_fn, mode = run_round, ""
     print(f"verify-chaos-invariants: {args.rounds} {mode}rounds, "
           f"base seed {base}", file=sys.stderr)
     for i in range(args.rounds):
@@ -195,7 +250,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         errors = round_fn(seed, timeout=args.timeout,
                           verbose=args.verbose)
         if errors:
-            repro_flag = " --sharded" if args.sharded else ""
+            repro_flag = (" --sharded" if args.sharded
+                          else " --rl" if args.rl else "")
             print(f"FAIL (repro: --seed {seed} --rounds 1{repro_flag}):",
                   file=sys.stderr)
             for e in errors:
@@ -205,6 +261,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("OK: converged under every fault profile; every sync on "
               "the owning shard, no double-reconcile, every crashed "
               "shard re-acquired, no orphans", file=sys.stderr)
+    elif args.rl:
+        print("OK: converged under every fault profile; actor kill "
+              "storms never restarted a learner or regressed a "
+              "committed step, no orphans, no duplicate admissions",
+              file=sys.stderr)
     else:
         print("OK: converged under every fault profile; no orphans, no "
               "duplicate admissions, every barrier resolved, no "

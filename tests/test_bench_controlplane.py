@@ -246,6 +246,54 @@ def test_oversubscribe_scenario_smoke_and_artifact_schema(capsys):
     assert ENV_KEYS <= set(artifact["env"])
 
 
+def test_rl_scenario_smoke_and_artifact_schema(capsys):
+    """--rl: the SAME actor kill-storm schedule run twice — a
+    heterogeneous gang (evict-class CPU-only actor pool beside
+    barrier-class learners) vs a homogeneous control where every
+    replica is a world member. The tiny-shape smoke pins the
+    mechanics, not the full acceptance spread (that is the default
+    shape's job): storms actually landed in both runs, the
+    heterogeneous learners never restarted and their committed step
+    never regressed (invariant list EMPTY), and heterogeneity beat
+    the control's restart-tax goodput."""
+    rc = bench_controlplane.main(["--rl", "--learners", "1",
+                                  "--actors", "2",
+                                  "--kill-rounds", "3",
+                                  "--save-interval", "12",
+                                  "--timeout", "60"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "artifact must be exactly one line"
+    artifact = json.loads(out[0])
+    assert rc == 0, artifact.get("invariant_violations",
+                                 artifact.get("error"))
+    assert artifact["metric"].startswith(
+        "controlplane_rl_learner_goodput")
+    assert artifact["unit"] == "ratio"
+    assert artifact["value"] == artifact["learner_goodput_ratio_rl"]
+    assert {"learner_goodput_ratio_rl", "learner_goodput_ratio_control",
+            "goodput_gap", "rl", "control",
+            "invariant_violations"} <= set(artifact)
+    assert artifact["invariant_violations"] == []
+    for mode in ("rl", "control"):
+        stats = artifact[mode]
+        assert {"heterogeneous", "goodput_ratio", "kill_rounds",
+                "kills", "learner_restarts", "committed_step_final",
+                "steps", "steps_executed"} <= set(stats)
+        # The storms actually landed: >=half the pool per round.
+        assert stats["kills"] >= stats["kill_rounds"]
+    assert artifact["rl"]["heterogeneous"] is True
+    assert artifact["control"]["heterogeneous"] is False
+    # Actor-only churn never touched the heterogeneous learner world...
+    assert artifact["rl"]["learner_restarts"] == 0
+    # ...while the homogeneous control paid a world restart per storm
+    # and rolled back to the last save each time.
+    assert artifact["control"]["learner_restarts"] >= 1
+    assert (artifact["learner_goodput_ratio_rl"]
+            > artifact["learner_goodput_ratio_control"])
+    assert artifact["goodput_gap"] > 0
+    assert ENV_KEYS <= set(artifact["env"])
+
+
 def test_sharded_scenario_smoke_and_artifact_schema(capsys):
     """--shards N: two replicas over N shard leases, a mid-run shard
     kill, zero-copy watch resume on takeover. The smoke pin: the fleet

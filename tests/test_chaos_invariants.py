@@ -55,6 +55,17 @@ ELASTIC_PINNED_SEEDS = (100, 2000, 2002, 2003)
 # here forever, same convention as above.
 SHARD_PINNED_SEEDS = (3000, 3003, 3007)
 
+# Heterogeneous-gang seeds (run_rl_round: every job carries an
+# evict-class CPU-only actor pool beside its barrier-class learners,
+# the disruptor is an actor KILL STORM — >=half the pool deleted at
+# once, no barrier — and the probes assert actor-only churn never
+# changes a learner pod's uid or regresses a committed step,
+# docs/rl.md). Clean-coverage sweeps of the 4000 block — 4006 draws
+# the heaviest schedule (4 jobs, 4-actor pools, two storms). Any seed
+# that ever exposes a learner-incarnation or committed-step regression
+# gets appended here forever, same convention as above.
+RL_PINNED_SEEDS = (4000, 4003, 4006)
+
 
 def _load():
     spec = importlib.util.spec_from_file_location("verify_chaos", SCRIPT)
@@ -82,6 +93,13 @@ def test_shard_pinned_seeds_hold_invariants():
     for seed in SHARD_PINNED_SEEDS:
         errors = vc.run_shard_round(seed, timeout=120.0)
         assert not errors, f"seed {seed} (sharded): {errors}"
+
+
+def test_rl_pinned_seeds_hold_invariants():
+    vc = _load()
+    for seed in RL_PINNED_SEEDS:
+        errors = vc.run_rl_round(seed, timeout=120.0)
+        assert not errors, f"seed {seed} (rl): {errors}"
 
 
 def test_cli_entrypoint_runs_clean():

@@ -1,0 +1,633 @@
+"""Heterogeneous gangs: RolePolicy resolution and the RL actor–learner
+workload (docs/rl.md).
+
+Pins the whole role-policy surface end to end:
+
+- resolver defaults reproduce the legacy hardcoded role sets exactly
+  (flag-off parity: a job without a rolePolicy block is byte-identical
+  to one from before the field existed, bootstrap hash included);
+- chip stamping derives from chipConsuming, not role names — a
+  CPU-only actor pool never gets google.com/tpu resources or the
+  nodepool toleration, and an override flips either direction;
+- actors get the learner-endpoint env OUTSIDE every bootstrap hash, so
+  actor-pool resizes (gang.resize_role) and learner resizes never
+  restart the other side;
+- gang admission counts an elastic-band role at its minReplicas floor;
+- save-before-evict barriers skip roles that EXPLICITLY opted out
+  (disruptionClass evict/ignore) and heterogeneous jobs publish the
+  learner goodput lane;
+- slice-health episodes touching only evict/ignore-class pods take the
+  per-pod actor lane (no barrier, no gang drain); a learner on the
+  same bad node sends the gang down the unchanged atomic-drain path;
+- e2e: an actor kill storm (>= 50% of the pool) mid-train leaves every
+  learner pod's uid, bootstrap-hash annotation, and the job's
+  committed step untouched while the pool heals.
+"""
+
+import datetime as dt
+import json
+import time
+
+import pytest
+
+from tf_operator_tpu import testutil
+from tf_operator_tpu.api import constants, set_defaults
+from tf_operator_tpu.api.types import (
+    CheckpointPolicy,
+    CheckpointRecord,
+    CheckpointRecordStatus,
+    DisruptionClass,
+    HealthPolicy,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    PodPhase,
+    ReplicaType,
+    RolePolicy,
+    SliceGroup,
+    SliceGroupSpec,
+    SliceGroupStatus,
+    TPUSliceSpec,
+    effective_role_policy,
+    elastic_role_types,
+)
+from tf_operator_tpu.api.validation import ValidationError, validate_job
+from tf_operator_tpu.bootstrap import learner_endpoints
+from tf_operator_tpu.controller.ckpt import CheckpointCoordinator
+from tf_operator_tpu.controller.engine import EngineConfig
+from tf_operator_tpu.controller.gang import (
+    PHASE_RUNNING,
+    SliceGangScheduler,
+)
+from tf_operator_tpu.controller.health import SliceHealthController
+from tf_operator_tpu.controller.tpu_controller import TPUJobController
+from tf_operator_tpu.runtime import metrics
+from tf_operator_tpu.runtime import store as store_mod
+from tf_operator_tpu.runtime.events import (
+    REASON_ACTOR_EVICTED,
+    REASON_SLICE_DRAINED,
+    Recorder,
+)
+from tf_operator_tpu.runtime.store import Store
+
+NS = "default"
+
+
+def _now():
+    return dt.datetime.now(dt.timezone.utc)
+
+
+def actor_policy(min_replicas=1, max_replicas=4,
+                 disruption=DisruptionClass.EVICT):
+    return RolePolicy(chip_consuming=False, preemptible=True,
+                      min_replicas=min_replicas,
+                      max_replicas=max_replicas,
+                      disruption_class=disruption)
+
+
+def make_rl_job(worker=2, actor=4, name="rl", namespace=NS,
+                accelerator="v5e-4", policy=None, ckpt=False):
+    job = testutil.new_tpujob(worker=worker, actor=actor, name=name,
+                              namespace=namespace,
+                              accelerator=accelerator)
+    job.spec.replica_specs[ReplicaType.ACTOR].role_policy = (
+        policy if policy is not None
+        else actor_policy(max_replicas=actor))
+    if ckpt:
+        job.spec.run_policy.checkpoint_policy = CheckpointPolicy(
+            enabled=True, directory="/tmp/ckpt",
+            barrier_timeout_seconds=30.0)
+    set_defaults(job)
+    return job
+
+
+def make_group(store, name, namespace=NS, min_member=2):
+    group = SliceGroup(
+        spec=SliceGroupSpec(min_member=min_member,
+                            slice=TPUSliceSpec(accelerator="v5e-4")),
+        status=SliceGroupStatus(phase=PHASE_RUNNING,
+                                pending_since=_now()))
+    group.metadata.name = name
+    group.metadata.namespace = namespace
+    group.metadata.labels = {constants.LABEL_JOB_NAME: name}
+    store.create(store_mod.SLICEGROUPS, group)
+    return group
+
+
+def add_pod(store, job, rtype, index, node="", phase=PodPhase.RUNNING):
+    pod = testutil.new_pod(job, rtype, index, phase=phase)
+    pod.spec.node_name = node
+    pod.metadata.annotations[constants.ANNOTATION_GANG_GROUP] = \
+        job.metadata.name
+    store.create(store_mod.PODS, pod)
+    return pod
+
+
+def wait_for(predicate, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# --- resolver -------------------------------------------------------------
+
+def test_resolver_defaults_match_legacy_role_sets():
+    """No rolePolicy anywhere: every role resolves to its historical
+    hardcoded treatment (the flag-off parity contract)."""
+    job = testutil.new_tpujob(worker=2, ps=1, chief=1, evaluator=1,
+                              actor=2)
+    job.spec.replica_specs[ReplicaType.ACTOR].role_policy = None
+    for rt, chip, disruption, data_plane in (
+            (ReplicaType.WORKER, True, DisruptionClass.BARRIER, True),
+            (ReplicaType.CHIEF, False, DisruptionClass.EVICT, True),
+            (ReplicaType.PS, False, DisruptionClass.EVICT, False),
+            (ReplicaType.EVALUATOR, False, DisruptionClass.EVICT, False),
+            (ReplicaType.ACTOR, False, DisruptionClass.EVICT, False),
+            # Serving's former special cases are now resolver defaults.
+            (ReplicaType.SERVING, True, DisruptionClass.BARRIER, False)):
+        eff = effective_role_policy(job, rt)
+        assert eff.chip_consuming is chip, rt
+        assert eff.disruption_class == disruption, rt
+        assert eff.data_plane is data_plane, rt
+        assert eff.explicit is False and eff.explicit_disruption is False
+        assert eff.elastic is False and eff.preemptible is False
+    assert elastic_role_types(job) == []
+
+
+def test_resolver_override_and_elastic_band():
+    job = make_rl_job()
+    eff = effective_role_policy(job, ReplicaType.ACTOR)
+    assert eff.explicit and eff.explicit_disruption
+    assert eff.chip_consuming is False and eff.preemptible is True
+    assert (eff.min_replicas, eff.max_replicas) == (1, 4)
+    assert eff.disruption_class == DisruptionClass.EVICT
+    assert eff.elastic is True and eff.barrier is False
+    assert elastic_role_types(job) == [ReplicaType.ACTOR]
+    # A band needs BOTH bounds to opt into the resize lane.
+    job.spec.replica_specs[ReplicaType.ACTOR].role_policy = RolePolicy(
+        chip_consuming=False, min_replicas=1)
+    assert effective_role_policy(job, ReplicaType.ACTOR).elastic is False
+
+
+def test_data_plane_membership_is_not_a_policy_knob():
+    """dataPlane is a property of what the role runs — a RolePolicy
+    cannot move a role in or out of the ranked jax world."""
+    job = testutil.new_tpujob(worker=2, actor=2)
+    job.spec.replica_specs[ReplicaType.WORKER].role_policy = RolePolicy(
+        chip_consuming=False, preemptible=True)
+    assert effective_role_policy(job, ReplicaType.WORKER).data_plane
+    job.spec.replica_specs[ReplicaType.ACTOR].role_policy = RolePolicy(
+        chip_consuming=True)
+    assert not effective_role_policy(job, ReplicaType.ACTOR).data_plane
+
+
+# --- validation -----------------------------------------------------------
+
+def test_role_policy_validation():
+    job = make_rl_job()
+    validate_job(job)  # the canonical RL shape is valid
+
+    spec = job.spec.replica_specs[ReplicaType.ACTOR]
+    spec.role_policy = actor_policy(disruption="sometimes")
+    with pytest.raises(ValidationError, match="disruptionClass"):
+        validate_job(job)
+
+    spec.role_policy = actor_policy(min_replicas=-1)
+    with pytest.raises(ValidationError, match="minReplicas"):
+        validate_job(job)
+
+    spec.role_policy = actor_policy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValidationError, match="maxReplicas"):
+        validate_job(job)
+
+    spec.role_policy = RolePolicy(chip_consuming=False, max_replicas=4)
+    with pytest.raises(ValidationError, match="set together"):
+        validate_job(job)
+
+    # replicas must start inside the band.
+    spec.role_policy = actor_policy(min_replicas=1, max_replicas=2)
+    with pytest.raises(ValidationError, match="maxReplicas"):
+        validate_job(job)
+
+    # Chip holders resize in whole slices, never by replica count.
+    spec.role_policy = actor_policy()
+    worker = job.spec.replica_specs[ReplicaType.WORKER]
+    worker.role_policy = RolePolicy(min_replicas=1, max_replicas=4)
+    with pytest.raises(ValidationError, match="non-chip-consuming"):
+        validate_job(job)
+
+
+# --- pod shape (chip stamping from chipConsuming, not role names) ---------
+
+def test_actor_pod_is_cpu_only_with_learner_endpoints():
+    store = Store()
+    controller = TPUJobController(store)
+    job = make_rl_job()
+    pod = testutil.new_pod(job, ReplicaType.ACTOR, 0)
+    controller.set_cluster_spec(job, pod, ReplicaType.ACTOR, 0)
+    container = pod.spec.containers[0]
+    # CPU-only: no chip request, no TPU-nodepool toleration.
+    assert all(constants.RESOURCE_TPU not in c.resources
+               for c in pod.spec.containers)
+    assert all(t.key != constants.RESOURCE_TPU
+               for t in pod.spec.tolerations)
+    # Outside the ranked world: no jax.distributed identity env.
+    assert not any(k.startswith("JAX_") for k in container.env)
+    # ...but full discovery of it: the learner endpoint list.
+    eps = container.env[constants.ENV_LEARNER_ENDPOINTS]
+    assert eps == learner_endpoints(job)
+    assert len(eps.split(",")) == 2
+    assert "worker-0" in eps and "worker-1" in eps
+
+
+def test_worker_pod_keeps_chips_and_world_env():
+    store = Store()
+    controller = TPUJobController(store)
+    job = make_rl_job()
+    pod = testutil.new_pod(job, ReplicaType.WORKER, 0)
+    controller.set_cluster_spec(job, pod, ReplicaType.WORKER, 0)
+    container = pod.spec.containers[0]
+    assert constants.RESOURCE_TPU in container.resources
+    assert any(t.key == constants.RESOURCE_TPU
+               for t in pod.spec.tolerations)
+    assert container.env["JAX_PROCESS_ID"] == "0"
+    # Learner discovery is the satellite roles' env, not the world's.
+    assert constants.ENV_LEARNER_ENDPOINTS not in container.env
+
+
+def test_chip_stamping_follows_chip_consuming_not_role_name():
+    store = Store()
+    controller = TPUJobController(store)
+    job = make_rl_job()
+    # Worker overridden to chipConsuming=False: no chips despite name.
+    job.spec.replica_specs[ReplicaType.WORKER].role_policy = RolePolicy(
+        chip_consuming=False)
+    pod = testutil.new_pod(job, ReplicaType.WORKER, 0)
+    controller.set_cluster_spec(job, pod, ReplicaType.WORKER, 0)
+    assert all(constants.RESOURCE_TPU not in c.resources
+               for c in pod.spec.containers)
+    assert all(t.key != constants.RESOURCE_TPU
+               for t in pod.spec.tolerations)
+    # Actor overridden to chipConsuming=True (no band): chips despite
+    # name — e.g. an actor pool doing on-chip inference.
+    job2 = make_rl_job(policy=RolePolicy(chip_consuming=True))
+    pod2 = testutil.new_pod(job2, ReplicaType.ACTOR, 0)
+    controller.set_cluster_spec(job2, pod2, ReplicaType.ACTOR, 0)
+    assert constants.RESOURCE_TPU in pod2.spec.containers[0].resources
+
+
+# --- flag-off parity ------------------------------------------------------
+
+def test_empty_role_policy_block_is_byte_identical_for_worker():
+    """An empty rolePolicy {} on a worker resolves to every default:
+    same rendered env, same bootstrap hash as no block at all."""
+    store = Store()
+    controller = TPUJobController(store)
+    plain = testutil.new_tpujob(worker=2, name="par", accelerator="v5e-4")
+    policied = testutil.new_tpujob(worker=2, name="par",
+                                   accelerator="v5e-4")
+    policied.metadata.uid = plain.metadata.uid
+    policied.spec.replica_specs[ReplicaType.WORKER].role_policy = \
+        RolePolicy()
+
+    def shape(job):
+        pod = testutil.new_pod(job, ReplicaType.WORKER, 0)
+        controller.set_cluster_spec(job, pod, ReplicaType.WORKER, 0)
+        return (dict(pod.spec.containers[0].env),
+                dict(pod.spec.containers[0].resources),
+                controller._compute_bootstrap_hash(
+                    job, ReplicaType.WORKER, 0))
+
+    assert shape(plain) == shape(policied)
+
+
+def test_default_satellite_roles_still_get_barrier_notices():
+    """Explicitness gates the notice skip: a ps pod with NO rolePolicy
+    resolves to evict-class by default but keeps getting the preempt
+    notice it always got (resolver defaults must not relax behavior);
+    an EXPLICIT evict-class actor never gets one."""
+    store = Store()
+    ckpt = CheckpointCoordinator(store)
+    job = make_rl_job(ckpt=True)
+    job.spec.replica_specs[ReplicaType.PS] = testutil.new_replica_spec(1)
+    set_defaults(job)
+    store.create(store_mod.TPUJOBS, job)
+    add_pod(store, job, ReplicaType.WORKER, 0)
+    add_pod(store, job, ReplicaType.PS, 0)
+    add_pod(store, job, ReplicaType.ACTOR, 0)
+
+    assert ckpt.ready_to_evict(NS, "rl", "test drain") is False
+    notice = constants.ANNOTATION_PREEMPT_NOTICE
+    assert notice in store.get(
+        store_mod.PODS, NS, "rl-worker-0").metadata.annotations
+    assert notice in store.get(
+        store_mod.PODS, NS, "rl-ps-0").metadata.annotations
+    assert notice not in store.get(
+        store_mod.PODS, NS, "rl-actor-0").metadata.annotations
+
+
+# --- bootstrap-hash invariance --------------------------------------------
+
+def test_actor_pool_resize_changes_no_bootstrap_hash():
+    """The elastic band's cluster entry is outside EVERY role's digest:
+    growing/shrinking the pool restarts nothing — not the learners,
+    not the band's own survivors. And the actor digest drops the
+    data-plane lists, so a learner resize leaves actors running too."""
+    store = Store()
+    controller = TPUJobController(store)
+    job = make_rl_job(worker=2, actor=2)
+
+    def hashes(j):
+        return {rt: controller._compute_bootstrap_hash(j, rt, 0)
+                for rt in (ReplicaType.WORKER, ReplicaType.ACTOR)}
+
+    before = hashes(job)
+    job.spec.replica_specs[ReplicaType.ACTOR].replicas = 4
+    assert hashes(job) == before
+
+    # Learner (worker) resize: the actor hash must hold (actors dial
+    # learners via ENV outside the hash); the worker world restarts.
+    job.spec.replica_specs[ReplicaType.WORKER].replicas = 3
+    after = hashes(job)
+    assert after[ReplicaType.ACTOR] == before[ReplicaType.ACTOR]
+    assert after[ReplicaType.WORKER] != before[ReplicaType.WORKER]
+
+
+# --- gang admission + the resize lane -------------------------------------
+
+def test_gang_min_member_counts_elastic_band_at_floor():
+    store = Store()
+    gang = SliceGangScheduler(store, total_chips=None)
+    job = make_rl_job(worker=2, actor=4,
+                      policy=actor_policy(min_replicas=1, max_replicas=6))
+    store.create(store_mod.TPUJOBS, job)
+    gang.sync_slice_group(job, job.spec.replica_specs)
+    group = store.get(store_mod.SLICEGROUPS, NS, "rl")
+    assert group.spec.min_member == 2 + 1  # workers + the band's floor
+
+    # Without a band the role counts in full (byte-identical default).
+    job2 = make_rl_job(worker=2, actor=4, name="rl2", policy=None)
+    job2.spec.replica_specs[ReplicaType.ACTOR].role_policy = None
+    store.create(store_mod.TPUJOBS, job2)
+    gang.sync_slice_group(job2, job2.spec.replica_specs)
+    assert store.get(store_mod.SLICEGROUPS, NS,
+                     "rl2").spec.min_member == 2 + 4
+
+
+def test_resize_role_grow_shrink_clamp_and_prune():
+    store = Store()
+    ckpt = CheckpointCoordinator(store)
+    # elastic=False on purpose: that flag gates SLICE resizes; the
+    # replica-count lane works without it (and on both backends).
+    gang = SliceGangScheduler(store, total_chips=None, ckpt=ckpt,
+                              elastic=False)
+    job = make_rl_job(worker=2, actor=2,
+                      policy=actor_policy(min_replicas=1, max_replicas=4))
+    store.create(store_mod.TPUJOBS, job)
+
+    def replicas():
+        return store.get(store_mod.TPUJOBS, NS,
+                         "rl").spec.replica_specs["actor"].replicas
+
+    assert gang.resize_role(NS, "rl", "actor", 4, "scale", "demand") \
+        is True
+    assert replicas() == 4
+    assert metrics.actor_pool_replicas.value(
+        job_namespace=NS, job="rl", replica_type="actor") == 4
+
+    # Clamped to the band on both ends.
+    assert gang.resize_role(NS, "rl", "actor", 99, "scale", "x") is False
+    assert replicas() == 4  # already at the clamped target: no-op
+    assert gang.resize_role(NS, "rl", "actor", 0, "scale", "x") is True
+    assert replicas() == 1
+    assert metrics.actor_pool_replicas.value(
+        job_namespace=NS, job="rl", replica_type="actor") == 1
+
+    # A shrink prunes departed replicas' CheckpointRecords so they
+    # never pin committed_step (actors normally publish none).
+    assert gang.resize_role(NS, "rl", "actor", 3, "scale", "x") is True
+    for i in range(3):
+        rec = CheckpointRecord(status=CheckpointRecordStatus(
+            step=5, progress_step=5))
+        rec.metadata.name = f"rl-actor-{i}"
+        rec.metadata.namespace = NS
+        rec.metadata.labels = {constants.LABEL_JOB_NAME: "rl"}
+        store.create(store_mod.CHECKPOINTRECORDS, rec)
+    assert gang.resize_role(NS, "rl", "actor", 1, "scale", "x") is True
+    assert store.try_get(store_mod.CHECKPOINTRECORDS, NS,
+                         "rl-actor-0") is not None
+    for i in (1, 2):
+        assert store.try_get(store_mod.CHECKPOINTRECORDS, NS,
+                             f"rl-actor-{i}") is None
+
+    # Not applicable: unknown job, or a role without an explicit band.
+    assert gang.resize_role(NS, "nope", "actor", 2, "scale", "x") is None
+    assert gang.resize_role(NS, "rl", "worker", 3, "scale", "x") is None
+
+
+# --- ckpt: barriers skip explicit evict-class roles -----------------------
+
+def test_barrier_skips_actors_and_publishes_learner_goodput():
+    store = Store()
+    ckpt = CheckpointCoordinator(store)
+    job = make_rl_job(worker=2, actor=2, name="rlb", ckpt=True)
+    store.create(store_mod.TPUJOBS, job)
+    worker_pods = [add_pod(store, job, ReplicaType.WORKER, i)
+                   for i in range(2)]
+    add_pod(store, job, ReplicaType.ACTOR, 0)
+    add_pod(store, job, ReplicaType.ACTOR, 1)
+
+    assert ckpt.ready_to_evict(NS, "rlb", "drain") is False
+    notice = json.loads(store.get(
+        store_mod.PODS, NS,
+        "rlb-worker-0").metadata.annotations[
+            constants.ANNOTATION_PREEMPT_NOTICE])
+    # Actors are neither stamped nor waited on: a Running actor with no
+    # CheckpointRecord can never gate the barrier.
+    for i in range(2):
+        pod = store.get(store_mod.PODS, NS, f"rlb-actor-{i}")
+        assert constants.ANNOTATION_PREEMPT_NOTICE \
+            not in pod.metadata.annotations
+
+    # Full LEARNER ack resolves the barrier — actors never acked.
+    for p in worker_pods:
+        rec = CheckpointRecord(status=CheckpointRecordStatus(
+            step=40, progress_step=40, barrier_id=notice["barrier"]))
+        rec.metadata.name = p.metadata.name
+        rec.metadata.namespace = NS
+        rec.metadata.labels = {constants.LABEL_JOB_NAME: "rlb"}
+        store.create(store_mod.CHECKPOINTRECORDS, rec)
+    assert ckpt.ready_to_evict(NS, "rlb", "drain") is True
+    assert ckpt.committed_step(NS, "rlb") == 40
+    # Heterogeneous jobs publish the learner goodput lane; nothing was
+    # lost (full ack), so the ratio is 1.0.
+    assert metrics.learner_goodput_ratio.value(
+        job_namespace=NS, job="rlb") == 1.0
+
+
+# --- health: the actor lane -----------------------------------------------
+
+def _health_env(store, job, bad_pods_spec, good_pods_spec):
+    """Nodes node-ok/node-bad + the given pods; returns the recorder."""
+    job.spec.run_policy.health_policy = HealthPolicy(enabled=True)
+    store.create(store_mod.TPUJOBS, job)
+    make_group(store, job.metadata.name, namespace=job.metadata.namespace)
+    for name, healthy in (("node-ok", True), ("node-bad", False)):
+        node = Node(spec=NodeSpec(chips=8),
+                    status=NodeStatus(phase="Ready"))
+        node.metadata.name = name
+        if not healthy:
+            node.status.conditions = {"MaintenancePending": "True"}
+        store.create(store_mod.NODES, node)
+    for rtype, idx in good_pods_spec:
+        add_pod(store, job, rtype, idx, node="node-ok")
+    for rtype, idx in bad_pods_spec:
+        add_pod(store, job, rtype, idx, node="node-bad")
+    recorder = Recorder()
+    gang = SliceGangScheduler(store, total_chips=None)
+    health = SliceHealthController(store, client=None, gang=gang,
+                                   recorder=recorder)
+    return health, recorder
+
+
+def test_health_evicts_actors_without_gang_drain():
+    store = Store()
+    ns = "rl-health"
+    job = make_rl_job(worker=2, actor=2, name="rlh", namespace=ns)
+    health, recorder = _health_env(
+        store, job,
+        bad_pods_spec=[(ReplicaType.ACTOR, 0), (ReplicaType.ACTOR, 1)],
+        good_pods_spec=[(ReplicaType.WORKER, 0), (ReplicaType.WORKER, 1)])
+    before = metrics.actor_preemptions.value(job_namespace=ns,
+                                             reason="health")
+    health.health_pass()
+    # Actors on the bad node deleted per-pod; the learner gang, its
+    # group phase, and its pods are untouched — no drain, no barrier.
+    live = {p.metadata.name for p in store.list(store_mod.PODS,
+                                                namespace=ns)}
+    assert live == {"rlh-worker-0", "rlh-worker-1"}
+    group = store.get(store_mod.SLICEGROUPS, ns, "rlh")
+    assert group.status.phase == PHASE_RUNNING
+    assert recorder.events_for("rlh", REASON_ACTOR_EVICTED)
+    assert not recorder.events_for("rlh", REASON_SLICE_DRAINED)
+    assert metrics.actor_preemptions.value(
+        job_namespace=ns, reason="health") == before + 2
+
+
+def test_health_ignore_class_pods_are_left_alone():
+    store = Store()
+    ns = "rl-ignore"
+    job = make_rl_job(worker=1, actor=1, name="rli", namespace=ns,
+                      policy=actor_policy(
+                          disruption=DisruptionClass.IGNORE))
+    health, recorder = _health_env(
+        store, job,
+        bad_pods_spec=[(ReplicaType.ACTOR, 0)],
+        good_pods_spec=[(ReplicaType.WORKER, 0)])
+    health.health_pass()
+    live = {p.metadata.name for p in store.list(store_mod.PODS,
+                                                namespace=ns)}
+    assert live == {"rli-worker-0", "rli-actor-0"}
+    assert not recorder.events_for("rli", REASON_ACTOR_EVICTED)
+    assert not recorder.events_for("rli", REASON_SLICE_DRAINED)
+
+
+def test_learner_on_bad_node_takes_the_drain_path():
+    """A learner sharing the degraded node disqualifies the actor lane:
+    the gang goes down the existing atomic-drain path unchanged."""
+    store = Store()
+    ns = "rl-drain"
+    job = make_rl_job(worker=2, actor=1, name="rld", namespace=ns)
+    health, recorder = _health_env(
+        store, job,
+        bad_pods_spec=[(ReplicaType.WORKER, 1), (ReplicaType.ACTOR, 0)],
+        good_pods_spec=[(ReplicaType.WORKER, 0)])
+    health.health_pass()
+    assert recorder.events_for("rld", REASON_SLICE_DRAINED)
+    assert store.list(store_mod.PODS, namespace=ns) == []
+
+
+# --- e2e: the actor kill storm --------------------------------------------
+
+def test_e2e_actor_kill_storm_preserves_learner_world():
+    """Mid-train, >= 50% of the actor pool is deleted at once. The
+    engine recreates the pool (fresh uids) while every learner pod
+    keeps its uid AND its bootstrap-hash annotation, and the job's
+    committed step never moves — the heterogeneous-gang acceptance
+    invariant (docs/rl.md), here against the real controller loop."""
+    ns = "rl-e2e"
+    store = Store()
+    ckpt = CheckpointCoordinator(store)
+    gang = SliceGangScheduler(store, total_chips=None, ckpt=ckpt)
+    controller = TPUJobController(
+        store, config=EngineConfig(enable_gang_scheduling=True),
+        gang=gang, namespace=ns, ckpt=ckpt)
+    controller.run(threadiness=2)
+    try:
+        job = make_rl_job(worker=2, actor=4, name="storm", namespace=ns,
+                          ckpt=True)
+        job = store.create(store_mod.TPUJOBS, job)
+        wait_for(lambda: store.count(store_mod.PODS) >= 6,
+                 msg="gang creation")
+
+        def pods(rtype):
+            return {p.metadata.name: p for p in store.list(
+                store_mod.PODS, namespace=ns)
+                if p.metadata.labels.get(
+                    constants.LABEL_REPLICA_TYPE) == rtype}
+
+        learners = pods("worker")
+        assert len(learners) == 2 and len(pods("actor")) == 4
+        world_before = {
+            name: (p.metadata.uid, p.metadata.annotations.get(
+                constants.ANNOTATION_BOOTSTRAP_HASH))
+            for name, p in learners.items()}
+        assert all(h for _, h in world_before.values())
+
+        # Mid-train state: learners have committed step 30.
+        for name in learners:
+            rec = CheckpointRecord(status=CheckpointRecordStatus(
+                step=30, progress_step=30))
+            rec.metadata.name = name
+            rec.metadata.namespace = ns
+            rec.metadata.labels = {constants.LABEL_JOB_NAME: "storm"}
+            store.create(store_mod.CHECKPOINTRECORDS, rec)
+        assert ckpt.committed_step(ns, "storm") == 30
+
+        # THE STORM: half the pool, one shot.
+        doomed = sorted(pods("actor"))[:2]
+        killed_uids = {n: pods("actor")[n].metadata.uid for n in doomed}
+        for name in doomed:
+            store.try_delete(store_mod.PODS, ns, name)
+
+        def pool_healed():
+            actors = pods("actor")
+            return (len(actors) == 4
+                    and all(actors[n].metadata.uid != killed_uids[n]
+                            for n in doomed if n in actors))
+
+        wait_for(pool_healed, msg="actor pool heal")
+
+        # The learner world never noticed: same uids, same bootstrap
+        # hashes, same committed step — no restart, no rollback.
+        learners_after = pods("worker")
+        assert {
+            name: (p.metadata.uid, p.metadata.annotations.get(
+                constants.ANNOTATION_BOOTSTRAP_HASH))
+            for name, p in learners_after.items()} == world_before
+        assert ckpt.committed_step(ns, "storm") == 30
+        # Recreated actors got the fresh learner-endpoint env.
+        for name, p in pods("actor").items():
+            env = p.spec.containers[0].env
+            assert constants.ENV_LEARNER_ENDPOINTS in env
+            assert not any(k.startswith("JAX_") for k in env)
+    finally:
+        controller.stop()
+        store.stop_watchers()
+
+
+# CI shard (pyproject [tool.pytest.ini_options] markers)
+pytestmark = pytest.mark.control_plane

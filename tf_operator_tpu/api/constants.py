@@ -134,3 +134,12 @@ ENV_SERVE_MAX_TOKENS = "TPUJOB_SERVE_MAX_TOKENS"
 # nominal chips), so request-level fair share follows the same handle
 # that decides chip fair share (docs/quota.md).
 ENV_SERVE_TENANT_WEIGHTS = "TPUJOB_SERVE_TENANT_WEIGHTS"
+
+# Env the controller renders into non-data-plane roles that carry an
+# explicit RolePolicy (RL actors; docs/rl.md): comma-joined
+# 'dns:port' endpoints of the job's learner (ranked) replicas, the
+# addresses an actor dials to stream experience / fetch parameters.
+# Outside the bootstrap hash like the ENV_CKPT_*/ENV_SERVE_* families —
+# and the actors' own membership is outside the LEARNERS' hashes — so
+# actor churn and learner discovery never restart anything.
+ENV_LEARNER_ENDPOINTS = "TPUJOB_LEARNER_ENDPOINTS"

@@ -54,8 +54,14 @@ class ReplicaType:
     # shared request spool. No reference analog (TFJob had no serving
     # workload kind).
     SERVING = "serving"
+    # TPU extension (docs/rl.md): an RL actor — a CPU-only replica that
+    # generates experience for the job's learner gang (Podracer-style
+    # actor–learner topology). Never joins the jax.distributed world and
+    # holds no chips; typically carries a RolePolicy making it freely
+    # preemptible and elastically resizable. No reference analog.
+    ACTOR = "actor"
 
-    ALL = (CHIEF, MASTER, WORKER, PS, EVALUATOR, SERVING)
+    ALL = (CHIEF, MASTER, WORKER, PS, EVALUATOR, SERVING, ACTOR)
 
 
 def is_chief_or_master(rtype: str) -> bool:
@@ -470,6 +476,64 @@ class ServingPolicy(ApiObject):
     scale_down_cooldown_seconds: float = 60.0
 
 
+class DisruptionClass:
+    """How the control plane may disrupt pods of a role (RolePolicy).
+
+    BARRIER: planned disruptions open the save-before-evict checkpoint
+             barrier and wait for the gang's acks before evicting
+             (controller/ckpt.py) — the learner/worker default.
+    EVICT:   pods may be evicted individually at any time with no
+             barrier, no drain episode, and no world restart — the
+             actor-pool semantics (the rest of the gang keeps running).
+    IGNORE:  the operator never disrupts these pods itself (health
+             drains skip them); only job teardown removes them.
+    """
+
+    BARRIER = "barrier"
+    EVICT = "evict"
+    IGNORE = "ignore"
+
+    ALL = (BARRIER, EVICT, IGNORE)
+
+
+@dataclasses.dataclass
+class RolePolicy(ApiObject):
+    """Per-replica-role scheduling/elasticity/QoS policy (docs/rl.md).
+
+    No reference analog: every TFJob knob was job-global. Heterogeneous
+    gangs (RL actor–learner, ROADMAP item 4) need per-role rules — the
+    job-global RunPolicy knobs remain the defaults that this policy
+    overrides for one role. Unset fields resolve to the role's
+    historical behavior (api/types.py effective_role_policy), so a job
+    with no rolePolicy is byte-identical to one from before this field
+    existed.
+
+    chip_consuming:   does this role hold TPU chips? Drives the
+                      google.com/tpu resource/toleration stamping and
+                      slice placement. None = derived from the role
+                      (worker/serving hold chips; everything else not).
+    preemptible:      advisory QoS marker: this role tolerates being
+                      disrupted freely (surfaced in status/docs; the
+                      enforcement lever is disruption_class).
+    min_replicas:     elastic floor for the role's replica count. With
+                      max_replicas it opts the role into replica-count
+                      resizes (gang.py resize_role): no bootstrap-hash
+                      change, no world restart — only for roles that
+                      resolve chip_consuming=False (chip holders resize
+                      in whole slices via slice.minSlices/maxSlices).
+    max_replicas:     elastic ceiling for the role's replica count.
+    disruption_class: see DisruptionClass. "" = derived from the role
+                      (worker/serving ride the barrier; the rest
+                      default to plain eviction).
+    """
+
+    chip_consuming: Optional[bool] = None
+    preemptible: Optional[bool] = None
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    disruption_class: str = ""
+
+
 @dataclasses.dataclass
 class RunPolicy(ApiObject):
     """Reference common/v1/types.go:107-148."""
@@ -496,6 +560,9 @@ class ReplicaSpec(ApiObject):
     replicas: Optional[int] = None
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
     restart_policy: str = ""
+    # TPU extension: per-role scheduling/elasticity/QoS overrides
+    # (docs/rl.md). None = the role behaves exactly as it always has.
+    role_policy: Optional[RolePolicy] = None
 
 
 @dataclasses.dataclass
@@ -544,6 +611,102 @@ class TPUJobSpec(ApiObject):
     # preserving pre-quota admission behavior. With tenant queues
     # disabled the field is carried but inert.
     queue_name: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Role-policy resolution (docs/rl.md). The single place the per-role
+# defaults live: every consumer (chip stamping, bootstrap-hash scope,
+# barrier membership, health drains, gang admission floors) resolves a
+# role through here instead of matching role names, so a new role — or
+# an override on an old one — changes behavior in exactly one place.
+# ---------------------------------------------------------------------------
+
+# Roles that join the jax.distributed data plane (receive process
+# ranks; bootstrap/cluster.py _RANKED_TYPES mirrors this). Everything
+# else — ps/evaluator/serving/actor — is outside the learner world:
+# its membership is stripped from bootstrap hashes so satellite churn
+# never restarts the ranked world.
+_DATA_PLANE_TYPES = (ReplicaType.CHIEF, ReplicaType.MASTER,
+                     ReplicaType.WORKER)
+
+# Historical chip holders / barrier riders. These ARE the old
+# hardcoded role checks (tpu_controller chip stamping, ckpt
+# _required_acks), now expressed once as resolver defaults.
+_DEFAULT_CHIP_TYPES = (ReplicaType.WORKER, ReplicaType.SERVING)
+_DEFAULT_BARRIER_TYPES = (ReplicaType.WORKER, ReplicaType.SERVING)
+
+
+@dataclasses.dataclass(frozen=True)
+class EffectiveRolePolicy:
+    """A role's RolePolicy with every unset field resolved to the
+    role's historical default. ``explicit``/``explicit_disruption``
+    record whether the spec actually carried the override — consumers
+    that relax legacy behavior (health's evict-only lane, notice-stamp
+    skipping) gate on explicitness so defaulted roles keep their exact
+    pre-RolePolicy treatment."""
+
+    replica_type: str = ""
+    chip_consuming: bool = False
+    preemptible: bool = False
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    disruption_class: str = DisruptionClass.EVICT
+    # Spec carried a rolePolicy block at all / carried disruptionClass.
+    explicit: bool = False
+    explicit_disruption: bool = False
+    # Role joins the ranked jax.distributed world (never overridable:
+    # it is a property of what the role runs, not a policy choice).
+    data_plane: bool = False
+
+    @property
+    def elastic(self) -> bool:
+        """Role opted into replica-count resizes (gang.py resize_role)."""
+        return (self.explicit and self.min_replicas is not None
+                and self.max_replicas is not None)
+
+    @property
+    def barrier(self) -> bool:
+        return self.disruption_class == DisruptionClass.BARRIER
+
+
+def effective_role_policy(job: "TPUJob",
+                          rtype: str) -> EffectiveRolePolicy:
+    """Resolve ``rtype``'s RolePolicy against the role defaults. With
+    no rolePolicy in the spec this reproduces today's behavior exactly
+    (the flag-off parity contract, tests/test_rl.py)."""
+    rt = rtype.lower()
+    spec = job.spec.replica_specs.get(rt) or job.spec.replica_specs.get(
+        rtype)
+    rp = spec.role_policy if spec is not None else None
+    chip_default = rt in _DEFAULT_CHIP_TYPES
+    barrier_default = rt in _DEFAULT_BARRIER_TYPES
+    return EffectiveRolePolicy(
+        replica_type=rt,
+        chip_consuming=(rp.chip_consuming
+                        if rp is not None and rp.chip_consuming is not None
+                        else chip_default),
+        preemptible=(rp.preemptible
+                     if rp is not None and rp.preemptible is not None
+                     else False),
+        min_replicas=rp.min_replicas if rp is not None else None,
+        max_replicas=rp.max_replicas if rp is not None else None,
+        disruption_class=(rp.disruption_class
+                          if rp is not None and rp.disruption_class
+                          else (DisruptionClass.BARRIER if barrier_default
+                                else DisruptionClass.EVICT)),
+        explicit=rp is not None,
+        explicit_disruption=rp is not None and bool(rp.disruption_class),
+        data_plane=rt in _DATA_PLANE_TYPES,
+    )
+
+
+def elastic_role_types(job: "TPUJob") -> List[str]:
+    """Replica types that opted into replica-count elasticity (an
+    explicit rolePolicy with both minReplicas and maxReplicas). Their
+    cluster membership is outside every bootstrap hash — resizing them
+    restarts nothing (tpu_controller._compute_bootstrap_hash)."""
+    return [rt for rt in job.spec.replica_specs
+            if effective_role_policy(job, rt).elastic]
 
 
 # ---------------------------------------------------------------------------

@@ -16,6 +16,7 @@ from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import (
     CleanPodPolicy,
     ClusterQueue,
+    DisruptionClass,
     ReclaimPolicy,
     ReplicaType,
     RestartPolicy,
@@ -78,6 +79,7 @@ def _spec_errors(spec: TPUJobSpec):
         if rspec.restart_policy and rspec.restart_policy not in RestartPolicy.ALL:
             yield (f"{path}.restartPolicy {rspec.restart_policy!r} invalid; "
                    f"expected one of {', '.join(RestartPolicy.ALL)}")
+        yield from _role_policy_errors(path, rtype, rspec)
         yield from _template_errors(path, rspec)
 
     if chief_like > 1:
@@ -162,6 +164,48 @@ def _spec_errors(spec: TPUJobSpec):
                "RFC-1123 label (alphanumerics and '-')")
 
     yield from _slice_errors(spec)
+
+
+def _role_policy_errors(path: str, rtype: str, rspec):
+    """Per-role RolePolicy validation (docs/rl.md). The elastic band
+    mirrors _slice_errors' minSlices/maxSlices checks, with one role
+    twist: minReplicas may be 0 (a pool may drain to nothing; a gang
+    below one slice cannot exist), and the band is only legal on roles
+    that resolve to chip_consuming=False — chip holders resize in whole
+    slices via spec.slice.minSlices/maxSlices."""
+    rp = rspec.role_policy
+    if rp is None:
+        return
+    rpath = f"{path}.rolePolicy"
+    if rp.disruption_class and rp.disruption_class not in DisruptionClass.ALL:
+        yield (f"{rpath}.disruptionClass {rp.disruption_class!r} invalid; "
+               f"expected one of {', '.join(DisruptionClass.ALL)}")
+    mn, mx = rp.min_replicas, rp.max_replicas
+    if mn is not None and mn < 0:
+        yield f"{rpath}.minReplicas must be >= 0"
+    if mx is not None and mx < 1:
+        yield f"{rpath}.maxReplicas must be >= 1"
+    if mn is not None and mx is not None and mx < mn:
+        yield f"{rpath}.maxReplicas ({mx}) must be >= minReplicas ({mn})"
+    if mn is None and mx is None:
+        return
+    if (mn is None) != (mx is None):
+        yield (f"{rpath}: minReplicas and maxReplicas must be set "
+               "together (the elastic band needs both bounds)")
+    chip = (rp.chip_consuming if rp.chip_consuming is not None
+            else rtype.lower() in (ReplicaType.WORKER,
+                                   ReplicaType.SERVING))
+    if chip:
+        yield (f"{rpath}: minReplicas/maxReplicas require a "
+               "non-chip-consuming role (chip holders resize in whole "
+               "slices via spec.slice.minSlices/maxSlices)")
+    n = rspec.replicas or 0
+    if mn is not None and mn >= 0 and n < mn:
+        yield (f"{path}.replicas ({n}) must be >= "
+               f"rolePolicy.minReplicas ({mn})")
+    if mx is not None and mx >= 1 and n > mx:
+        yield (f"{path}.replicas ({n}) must be <= "
+               f"rolePolicy.maxReplicas ({mx})")
 
 
 def _template_errors(path: str, rspec):

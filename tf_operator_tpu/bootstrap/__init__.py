@@ -9,5 +9,6 @@ from tf_operator_tpu.bootstrap.topology import SliceTopology, parse_accelerator 
 from tf_operator_tpu.bootstrap.cluster import (  # noqa: F401
     ClusterSpec,
     build_cluster_spec,
+    learner_endpoints,
     render_worker_env,
 )

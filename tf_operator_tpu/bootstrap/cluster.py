@@ -162,6 +162,29 @@ def coordinator_address(job: TPUJob, domain: Optional[str] = None) -> str:
     raise ValueError(f"job {job.key()} has no coordinator-capable replica type")
 
 
+def learner_endpoints(job: TPUJob, domain: Optional[str] = None) -> str:
+    """Comma-joined 'dns:port' endpoints of the job's learner (ranked)
+    replicas — what an RL actor dials to stream experience and fetch
+    parameters (docs/rl.md). The ps/evaluator treatment in reverse:
+    actors discover learners through env OUTSIDE every bootstrap hash,
+    so neither side restarts when the other churns. Rendered from the
+    current spec at pod-create time; like the sparse-elastic ps view it
+    may go stale across a learner resize (actors re-resolve by DNS or
+    get the fresh list on their next recreate)."""
+    if domain is None:
+        domain = _cluster_domain()
+    endpoints: List[str] = []
+    for rtype in _RANKED_TYPES:
+        spec = job.spec.replica_specs.get(rtype)
+        if spec is None:
+            continue
+        port = replica_port(job, rtype)
+        for i in range(spec.replicas or 0):
+            endpoints.append(
+                f"{replica_dns_name(job, rtype, i, domain)}:{port}")
+    return ",".join(endpoints)
+
+
 def render_worker_env(job: TPUJob, rtype: str, index: int,
                       domain: Optional[str] = None) -> Dict[str, str]:
     """Env the engine injects into the default container at pod-create time

@@ -447,6 +447,14 @@ class SliceGangBinder:
             self.store.try_get(store_mod.TPUJOBS, ns, name))
         prefer_clean = policy is None or policy.prefer_spare_capacity
 
+        # Worker pods place as whole slices in one ICI domain; every
+        # other role — chief/ps/evaluator, serving off-slice, and
+        # CPU-only RolePolicy roles like RL actor pools (docs/rl.md) —
+        # takes the flexible path: pure cpu/mem/taint predicate fit
+        # (_pick_flexible_node), zero chip demand unless its containers
+        # declare google.com/tpu (the controller only stamps chips for
+        # chipConsuming roles, tpu_controller.set_cluster_spec), so a
+        # 100-actor pool never touches the slice budget or topology.
         by_slice: Dict[int, List[Pod]] = {}
         flexible: List[Pod] = []
         for p in group_pods:

@@ -62,10 +62,11 @@ from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import (
     CheckpointPolicy,
     CheckpointRecord,
+    DisruptionClass,
     JobConditionType,
     Pod,
-    ReplicaType,
     TPUJob,
+    effective_role_policy,
 )
 from tf_operator_tpu.controller import conditions as cond
 from tf_operator_tpu.runtime import metrics
@@ -264,10 +265,10 @@ class CheckpointCoordinator:
             # Stamp the notice level-triggered: pods missed on an earlier
             # pass (conflicts, stragglers the engine just recreated) get
             # it on this one.
-            self._stamp_notices(pods, barrier)
+            self._stamp_notices(job, pods, barrier)
             records = self._records(namespace, name)
             self._count_acks(namespace, barrier, records)
-            required = self._required_acks(barrier, pods, records)
+            required = self._required_acks(job, barrier, pods, records)
             if required and required <= barrier.acked:
                 self._complete(job, key, barrier, OUTCOME_ACKED, records)
                 return True
@@ -309,7 +310,8 @@ class CheckpointCoordinator:
         return [r for r in records
                 if _record_in_world(job, r.metadata.name)]
 
-    def _stamp_notices(self, pods: List[Pod], barrier: _Barrier) -> None:
+    def _stamp_notices(self, job: Optional[TPUJob], pods: List[Pod],
+                       barrier: _Barrier) -> None:
         notice = json.dumps({
             "barrier": barrier.id,
             "deadline": barrier.deadline_wall.strftime(
@@ -319,6 +321,15 @@ class CheckpointCoordinator:
         from tf_operator_tpu.runtime import retry as retry_mod
 
         for pod in pods:
+            if job is not None and _explicitly_non_barrier(
+                    job, pod.metadata.labels.get(
+                        constants.LABEL_REPLICA_TYPE, "")):
+                # Roles that EXPLICITLY opted out of the barrier
+                # (disruptionClass evict/ignore — RL actors) never get
+                # the notice: forcing a final save on a stateless actor
+                # just delays the gang's eviction. Default-policy roles
+                # keep today's stamping byte-for-byte.
+                continue
             if pod.metadata.name in barrier.stamped:
                 continue
             if pod.metadata.annotations.get(
@@ -385,27 +396,29 @@ class CheckpointCoordinator:
                 metrics.checkpoint_barrier_acks.inc(job_namespace=namespace)
 
     @staticmethod
-    def _required_acks(barrier: _Barrier, pods: List[Pod],
+    def _required_acks(job: Optional[TPUJob], barrier: _Barrier,
+                       pods: List[Pod],
                        records: List[CheckpointRecord]) -> Set[str]:
         """Who must ack before the barrier completes early: every
-        stamped Running WORKER pod (workers hold the model shards — a
-        distributed checkpoint missing one shard is unrestorable, so a
-        worker that has not even made its FIRST save still gates the
-        eviction), plus any stamped pod already known to checkpoint
-        (it carries a CheckpointRecord — covers non-worker types that
-        opted into the hook). Coordinator-only pods (chief/ps) that
-        never published a record are never waited on; the barrier
-        timeout bounds everything else."""
+        stamped Running pod of a BARRIER-class role (the resolver
+        defaults worker/serving to barrier — workers hold the model
+        shards, a distributed checkpoint missing one shard is
+        unrestorable, so a worker that has not even made its FIRST save
+        still gates the eviction; a serving replica's "save" is
+        re-spooling in-flight sequences, serve/worker.py), plus any
+        stamped pod already known to checkpoint (it carries a
+        CheckpointRecord — covers non-worker types that opted into the
+        hook). Coordinator-only pods (chief/ps) and evict/ignore-class
+        roles (RL actors) that never published a record are never
+        waited on; the barrier timeout bounds everything else."""
         with_records = {r.metadata.name for r in records}
-        workers = {p.metadata.name for p in pods
-                   if p.status.phase == "Running"
-                   and p.metadata.labels.get(
-                       constants.LABEL_REPLICA_TYPE, "").lower()
-                   # Serving replicas gate like workers: their "save" is
-                   # re-spooling in-flight sequences (serve/worker.py) —
-                   # evicting before the ack drops live requests.
-                   in (ReplicaType.WORKER, ReplicaType.SERVING)}
-        return barrier.stamped & (with_records | workers)
+        gated = {p.metadata.name for p in pods
+                 if p.status.phase == "Running"
+                 and job is not None
+                 and effective_role_policy(
+                     job, p.metadata.labels.get(
+                         constants.LABEL_REPLICA_TYPE, "")).barrier}
+        return barrier.stamped & (with_records | gated)
 
     def _complete(self, job: Optional[TPUJob], key: Tuple[str, str],
                   barrier: _Barrier, outcome: str,
@@ -423,7 +436,7 @@ class CheckpointCoordinator:
         metrics.steps_lost_per_disruption.observe(float(lost),
                                                   job_namespace=ns)
         self._lost_steps[key] = self._lost_steps.get(key, 0) + lost
-        self._publish_goodput(key, progress)
+        self._publish_goodput(key, progress, job)
         elapsed = self.clock() - barrier.started
         # Phase attribution: open->resolve elapsed is the disruption's
         # "barrier_wait" — the time capacity reclaim spent waiting on
@@ -456,12 +469,21 @@ class CheckpointCoordinator:
                 f"evicting anyway — about {lost} step(s) lost")
         self._completed[key] = outcome
 
-    def _publish_goodput(self, key: Tuple[str, str], progress: int) -> None:
+    def _publish_goodput(self, key: Tuple[str, str], progress: int,
+                         job: Optional[TPUJob] = None) -> None:
         lost = self._lost_steps.get(key, 0)
         if progress > 0:
+            ratio = max(0.0, (progress - lost) / progress)
             metrics.job_goodput_ratio.set(
-                max(0.0, (progress - lost) / progress),
-                job_namespace=key[0], job=key[1])
+                ratio, job_namespace=key[0], job=key[1])
+            if job is not None and _heterogeneous(job):
+                # Heterogeneous jobs additionally publish the learner
+                # lane: records come only from barrier-class (learner)
+                # replicas — actors publish none — so this IS the
+                # learner gang's goodput, and actor-only churn must
+                # leave it at 1.0 (docs/rl.md).
+                metrics.learner_goodput_ratio.set(
+                    ratio, job_namespace=key[0], job=key[1])
 
     # -- restore-with-identity (bootstrap env) ---------------------------
 
@@ -559,12 +581,31 @@ class CheckpointCoordinator:
         progress = max((r.status.progress_step for r in records
                         if r.status.progress_step >= 0), default=-1)
         with self._lock:
-            self._publish_goodput(key, progress)
+            self._publish_goodput(key, progress, job)
 
     def _record_event(self, job, etype: str, reason: str,
                       msg: str) -> None:
         if self.recorder is not None and job is not None:
             self.recorder.event(job, etype, reason, msg)
+
+
+def _explicitly_non_barrier(job: TPUJob, rtype: str) -> bool:
+    """True when the role EXPLICITLY opted out of save-before-evict
+    (RolePolicy.disruptionClass evict/ignore). Explicitness matters:
+    resolver DEFAULTS must not relax behavior — a chief/ps pod with no
+    RolePolicy resolves to evict-class but keeps getting the notice it
+    always got (flag-off parity, docs/rl.md)."""
+    eff = effective_role_policy(job, rtype)
+    return eff.explicit_disruption and eff.disruption_class in (
+        DisruptionClass.EVICT, DisruptionClass.IGNORE)
+
+
+def _heterogeneous(job: TPUJob) -> bool:
+    """A job with at least one explicitly non-barrier role — the
+    actor/learner split that makes a separate learner goodput lane
+    meaningful."""
+    return any(_explicitly_non_barrier(job, rt)
+               for rt in job.spec.replica_specs)
 
 
 def _record_in_world(job: TPUJob, record_name: str) -> bool:

@@ -37,6 +37,7 @@ from tf_operator_tpu.api.types import (
     SliceGroupSpec,
     SliceGroupStatus,
     TPUJob,
+    effective_role_policy,
 )
 from tf_operator_tpu.controller.control import controller_owner_ref
 from tf_operator_tpu.controller.engine import GangScheduler
@@ -266,7 +267,18 @@ class SliceGangScheduler(GangScheduler):
                          replica_specs: Dict[str, ReplicaSpec]) -> None:
         """Create/refresh the job's SliceGroup and run admission
         (reference SyncPodGroup, job_controller.go:218-245)."""
-        total = sum(s.replicas or 0 for s in replica_specs.values())
+        total = 0
+        for rt, s in replica_specs.items():
+            n = s.replicas or 0
+            eff = effective_role_policy(job, rt)
+            if eff.elastic:
+                # An elastic-band role (RL actor pool, docs/rl.md) gangs
+                # at its FLOOR: the job must not wait on — or be demoted
+                # by — actors above minReplicas, which come and go by
+                # design. Roles without an explicit band keep counting
+                # in full, so default minMember is byte-identical.
+                n = min(n, eff.min_replicas or 0)
+            total += n
         min_member = total
         queue = ""
         priority = ""
@@ -506,6 +518,93 @@ class SliceGangScheduler(GangScheduler):
             return False  # previous resize still settling
         return self._resize(namespace, name, new_n, "shrink",
                             reason_label, message)
+
+    def resize_role(self, namespace: str, name: str, rtype: str,
+                    new_replicas: int, reason_label: str,
+                    message: str) -> Optional[bool]:
+        """Elastic ROLE resize (docs/rl.md): change the replica count of
+        one elastic-band role (an RL actor pool) inside its
+        RolePolicy.minReplicas..maxReplicas band. Unlike the slice
+        resize lane this is NOT a world restart — the band's cluster
+        entry is outside every bootstrap hash
+        (tpu_controller._compute_bootstrap_hash), the gang's minMember
+        counts the band at its floor (sync_slice_group), and no
+        save-before-evict barrier opens (the band is preemptible by
+        contract) — so the engine just deletes out-of-range pods or
+        creates missing ones while the learner world keeps stepping.
+        Deliberately caller-driven (tests, harnesses, operators, a
+        future actor autoscaler): the control plane never auto-shrinks
+        a pool on health events, because no signal exists to grow it
+        back (CPU capacity is not chip capacity). Works on both
+        backends and does not require ``elastic`` (that flag gates
+        SLICE resizes, which mutate container env).
+
+        Returns None = not applicable (no such job/role, or the role
+        declares no explicit band), False = held (degraded control
+        plane, clamp made it a no-op), True = the new pool size landed
+        in the job spec."""
+        rt = rtype.lower()
+        job = self.store.try_get(store_mod.TPUJOBS, namespace, name)
+        if job is None:
+            return None
+        eff = effective_role_policy(job, rt)
+        if not eff.elastic:
+            return None
+        if (self.cp_health is not None
+                and not self.cp_health.allow_disruption("resize")):
+            trace_mod.JOURNAL.record(
+                namespace, name, "disruption.deferred",
+                "controlplane-degraded",
+                f"role {rt} resize ({message}) deferred: the API "
+                "server is degraded (docs/robustness.md)")
+            return False
+        target = max(eff.min_replicas or 0,
+                     min(new_replicas, eff.max_replicas or new_replicas))
+        applied: Dict[str, int] = {}
+
+        def mutate(cur):
+            spec = cur.spec.replica_specs.get(rt)
+            if spec is None:
+                return False
+            cur_n = spec.replicas or 0
+            if cur_n == target:
+                return False
+            applied["old"] = cur_n
+            spec.replicas = target
+
+        from tf_operator_tpu.runtime import retry as retry_mod
+
+        job = retry_mod.update_with_conflict_retry(
+            self.store, store_mod.TPUJOBS, namespace, name, mutate,
+            component="gang.resize_role")
+        if job is None or "old" not in applied:
+            return False
+        direction = "grow" if target > applied["old"] else "shrink"
+        if direction == "shrink" and self.ckpt is not None:
+            # Departed band replicas must not pin committed_step: prune
+            # their CheckpointRecords like a slice shrink does (actors
+            # normally publish none — level-triggered no-op then).
+            self.ckpt.prune_departed_records(
+                namespace, name, rt, target, applied["old"])
+        metrics.gang_resizes.inc(direction=direction, reason=reason_label)
+        metrics.actor_pool_replicas.set(target, job_namespace=namespace,
+                                        job=name, replica_type=rt)
+        detail = (f"{direction} {rt} pool to {target} replica(s): "
+                  f"{message}")
+        log.info("resized role %s of %s/%s: %s", rt, namespace, name,
+                 detail)
+        trace_mod.JOURNAL.record(
+            namespace, name, "role-resized", reason_label, detail,
+            direction=direction, replica_type=rt, replicas=target)
+        if self.recorder is not None:
+            try:
+                self.recorder.event(
+                    job, EVENT_TYPE_NORMAL, REASON_GANG_RESIZED,
+                    f"Role {rt} of {name} resized ({detail}); the "
+                    "learner world keeps running")
+            except Exception:
+                log.debug("GangResized event emit failed", exc_info=True)
+        return True
 
     def _try_shrink_for_reclaim(self, namespace: str, name: str,
                                 chips_needed: int, reason: str):

@@ -66,11 +66,13 @@ from typing import Dict, List, Optional, Tuple
 
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import (
+    DisruptionClass,
     HealthPolicy,
     Node,
     Pod,
     ReplicaType,
     TPUJob,
+    effective_role_policy,
 )
 from tf_operator_tpu.runtime import metrics
 from tf_operator_tpu.runtime import store as store_mod
@@ -312,6 +314,17 @@ class SliceHealthController:
             if (not policy.handle_maintenance
                     and all(r == COND_MAINTENANCE for r in reasons)):
                 continue  # advance notices explicitly ignored by policy
+            if self._evict_class_only(ns, name, job, bad_pods, reasons):
+                # Every doomed pod belongs to a role that EXPLICITLY
+                # opted out of the barrier (disruptionClass evict or
+                # ignore — RL actors, docs/rl.md): evict-class pods are
+                # deleted immediately (no grace, no barrier, no gang
+                # displacement — the engine recreates them on healthy
+                # capacity), ignore-class pods are left alone entirely.
+                # The learner world never notices. Default-policy roles
+                # never take this lane, so homogeneous gangs keep the
+                # atomic-drain path byte-for-byte.
+                continue
             grace = (policy.drain_grace_seconds
                      if policy.drain_grace_seconds is not None
                      else self.default_grace_seconds)
@@ -356,6 +369,84 @@ class SliceHealthController:
                 # behind a wedged worker.
                 continue
             self._drain(ns, name, job, bad_pods, reasons)
+
+    def _evict_class_only(self, ns: str, name: str, job: TPUJob,
+                          bad_pods: List[Pod],
+                          reasons: List[str]) -> bool:
+        """The actor lane (docs/rl.md): when EVERY pod of the gang on a
+        degraded node belongs to a role whose RolePolicy explicitly
+        declares disruptionClass evict or ignore, handle the episode
+        per-pod instead of per-gang — delete the evict-class pods (the
+        engine recreates them elsewhere; no barrier, no displacement,
+        no Restarting arc) and skip ignore-class ones. Returns True
+        when the episode was handled here (including "all ignored");
+        False sends the gang down the existing drain path — which is
+        what happens whenever a learner shares the bad node, because
+        learners resolve to barrier class."""
+        classified = []
+        for p in bad_pods:
+            eff = effective_role_policy(
+                job, p.metadata.labels.get(constants.LABEL_REPLICA_TYPE,
+                                           ""))
+            if not (eff.explicit_disruption and eff.disruption_class in
+                    (DisruptionClass.EVICT, DisruptionClass.IGNORE)):
+                return False
+            classified.append((p, eff.disruption_class))
+        to_evict = [p for p, c in classified
+                    if c == DisruptionClass.EVICT]
+        if not to_evict:
+            return True  # all ignore-class: leave them where they are
+        if (self.cp_health is not None
+                and not self.cp_health.allow_disruption("drain")):
+            trace_mod.JOURNAL.record(
+                ns, name, "disruption.deferred", "controlplane-degraded",
+                f"actor eviction ({', '.join(reasons)}) deferred: the "
+                "API server is degraded (docs/robustness.md)")
+            return True
+        from tf_operator_tpu.runtime import retry as retry_mod
+
+        evicted = []
+        for p in to_evict:
+            try:
+                if self.pod_control is not None:
+                    retry_mod.with_retries(
+                        lambda p=p: self.pod_control.delete_pod(
+                            ns, p.metadata.name, job),
+                        component="health.actor_evict",
+                        health=self.cp_health)
+                else:
+                    retry_mod.with_retries(
+                        lambda p=p: self.store.try_delete(
+                            store_mod.PODS, ns, p.metadata.name),
+                        component="health.actor_evict",
+                        health=self.cp_health)
+            except Exception as e:
+                log.warning("evicting actor pod %s/%s failed (will "
+                            "retry): %s", ns, p.metadata.name, e)
+                continue
+            evicted.append(p.metadata.name)
+            metrics.actor_preemptions.inc(job_namespace=ns,
+                                          reason="health")
+        if evicted:
+            reason_str = ", ".join(reasons)
+            trace_mod.JOURNAL.record(
+                ns, name, "actor-evicted", "node-degraded",
+                f"{len(evicted)} evict-class replica(s) deleted off "
+                f"degraded node(s) ({reason_str}); no barrier, no gang "
+                "drain — the learner world keeps running")
+            log.info("evicted %d evict-class pod(s) of gang %s/%s off "
+                     "degraded node(s) (%s); learner world untouched",
+                     len(evicted), ns, name, reason_str)
+            from tf_operator_tpu.runtime.events import (
+                REASON_ACTOR_EVICTED,
+            )
+
+            self._record(job, EVENT_TYPE_NORMAL, REASON_ACTOR_EVICTED,
+                         f"{len(evicted)} evict-class replica(s) of "
+                         f"{name} evicted off degraded node(s) "
+                         f"({reason_str}); recreated on healthy "
+                         "capacity, learner gang unaffected")
+        return True
 
     def _try_elastic_shrink(self, ns: str, name: str, job: TPUJob,
                             bad_pods: List[Pod],

@@ -23,6 +23,14 @@ already exist:
 Without the flag, none of this runs and the ``serving`` role is inert:
 its pods are reconciled like any other replica type, byte-identical to
 a generic role (pinned by the control test in tests/test_serving.py).
+
+Role-policy note (docs/rl.md): the serving role's former special cases
+— chip stamping, bootstrap-hash membership, barrier gating — are now
+resolved through ``api/types.effective_role_policy``, whose DEFAULTS
+for ``serving`` (chipConsuming=True, disruptionClass=barrier,
+dataPlane=False) reproduce the old hardcoded behavior exactly; a
+RolePolicy on the serving replica spec can override them like any
+other role's.
 """
 
 from __future__ import annotations

@@ -21,13 +21,15 @@ from tf_operator_tpu.api.types import (
     ReplicaType,
     TPUJob,
     JobConditionType,
+    effective_role_policy,
+    elastic_role_types,
 )
 from tf_operator_tpu.api.validation import (
     ValidationError,
     validate_job,
     validation_warnings,
 )
-from tf_operator_tpu.bootstrap import render_worker_env
+from tf_operator_tpu.bootstrap import learner_endpoints, render_worker_env
 from tf_operator_tpu.controller import conditions as cond
 from tf_operator_tpu.controller import status as status_mod
 from tf_operator_tpu.controller.control import (
@@ -297,7 +299,11 @@ class TPUJobController(JobPlugin):
         journal would keep answering for a job that no longer exists."""
         ns, name = job.metadata.namespace, job.metadata.name
         metrics.job_goodput_ratio.remove(job_namespace=ns, job=name)
+        metrics.learner_goodput_ratio.remove(job_namespace=ns, job=name)
         metrics.job_slices.remove(job_namespace=ns, job=name)
+        for rt in list(job.spec.replica_specs):
+            metrics.actor_pool_replicas.remove(
+                job_namespace=ns, job=name, replica_type=rt.lower())
         trace_mod.JOURNAL.prune(ns, name)
 
     def _garbage_collect(self, job: TPUJob) -> None:
@@ -709,9 +715,13 @@ class TPUJobController(JobPlugin):
         # chips (bootstrap/cluster.py:236-243).
         # Serving replicas hold chips like workers: they run the model's
         # decode path on the slice (chief/ps/evaluator remain
-        # coordinator-only, bootstrap/cluster.py:236-243).
-        chip_holder = rtype.lower() in (ReplicaType.WORKER,
-                                        ReplicaType.SERVING)
+        # coordinator-only, bootstrap/cluster.py:236-243). The role's
+        # RolePolicy decides chip ownership — the resolver defaults to
+        # exactly the old worker/serving name set, and chipConsuming
+        # overrides it either way (a CPU-only actor pool must never get
+        # TPU resources or the nodepool toleration stamped; docs/rl.md).
+        eff = effective_role_policy(job, rtype)
+        chip_holder = eff.chip_consuming
         if (job.spec.slice.accelerator and chip_holder
                 and not any(constants.RESOURCE_TPU in c.resources
                             for c in pod.spec.containers)):
@@ -749,6 +759,17 @@ class TPUJobController(JobPlugin):
         # restart live serving replicas mid-traffic).
         if self.serving is not None:
             container.env.update(self.serving.bootstrap_env(job, rtype))
+        # Learner discovery for RolePolicy'd satellite roles (RL actors;
+        # docs/rl.md): the current learner (ranked-replica) endpoints,
+        # rendered like the ps view in reverse — OUTSIDE the bootstrap
+        # hash (it is computed from render_worker_env alone), so learner
+        # resizes never restart actors and actor churn never touches
+        # learners. Only roles that explicitly opted into a RolePolicy
+        # get it: default pod shapes stay byte-identical.
+        if eff.explicit and not eff.data_plane:
+            endpoints = learner_endpoints(job)
+            if endpoints:
+                container.env[constants.ENV_LEARNER_ENDPOINTS] = endpoints
         # Node-agent relay (runtime/relay.py): mount the shared relay
         # volume and render the notice/checkpoint file paths for pods a
         # coordination subsystem will actually talk to. Token-keyed, not
@@ -834,19 +855,32 @@ class TPUJobController(JobPlugin):
             d.pop("task", None)
             if sparse:
                 (d.get("cluster") or {}).pop(ReplicaType.WORKER, None)
-            if rt in (ReplicaType.PS, ReplicaType.EVALUATOR,
-                      ReplicaType.SERVING):
+            if not effective_role_policy(job, rtype).data_plane:
                 # Non-data-plane roles never DIAL the jax world through
                 # the spec (ps serves, workers dial it; bootstrap
                 # renders them no JAX_* env) — so a worker/chief resize
                 # must not restart them: a ps restart interrupts the
-                # whole job's parameter serving for nothing, and a
-                # serving restart drops live decode traffic. Their
+                # whole job's parameter serving for nothing, a serving
+                # restart drops live decode traffic, and an actor
+                # restart throws away in-flight trajectories. Their
                 # digest keeps the entries peers reach THEM by (their
-                # own role list) and drops the data-plane lists.
+                # own role list) and drops the data-plane lists. Same
+                # predicate the resolver gives every consumer: dataPlane
+                # is fixed per replica type (chief/master/worker), not a
+                # RolePolicy knob.
                 for t in (ReplicaType.CHIEF, ReplicaType.MASTER,
                           ReplicaType.WORKER):
                     (d.get("cluster") or {}).pop(t, None)
+            for t in elastic_role_types(job):
+                # Elastic-band roles (RL actor pools) resize by replica
+                # count with NO world restart: their cluster entry
+                # leaves EVERY role's digest, so an actor grow/shrink
+                # changes no pod's bootstrap hash — learners included,
+                # and the band's own surviving pods (its own list must
+                # not be in its own digest, or a shrink would restart
+                # the pool it kept). Peers that need actors find them
+                # by DNS, not by the rendered list.
+                (d.get("cluster") or {}).pop(t, None)
             env["TPUJOB_CLUSTER_SPEC"] = _json.dumps(d, sort_keys=True)
         if sparse:
             env.pop("JAX_NUM_PROCESSES", None)

@@ -58,6 +58,11 @@ REASON_QUEUE_DELETED = "QueueDeleted"
 # docs/elastic.md) — one event per applied grow/shrink.
 REASON_GANG_RESIZED = "GangResized"
 
+# Heterogeneous-gang event reasons (docs/rl.md): evict-class replicas
+# (RL actors) removed from a degraded node WITHOUT a barrier or a gang
+# drain — the learner world keeps running.
+REASON_ACTOR_EVICTED = "ActorEvicted"
+
 
 @dataclass
 class Event:

@@ -423,6 +423,23 @@ job_goodput_ratio = REGISTRY.gauge(
     "Fraction of a job's training steps NOT lost to disruptions: "
     "(progress - cumulative steps lost) / progress, 1.0 until the "
     "first loss", ["job_namespace", "job"])
+learner_goodput_ratio = REGISTRY.gauge(
+    "tpu_operator_learner_goodput_ratio",
+    "job_goodput_ratio restricted to heterogeneous (RolePolicy) jobs: "
+    "fraction of the LEARNER gang's steps not lost to disruptions. "
+    "Actor-only churn must not move it — that invariant is the point "
+    "of the actor/learner split (docs/rl.md)", ["job_namespace", "job"])
+actor_pool_replicas = REGISTRY.gauge(
+    "tpu_operator_actor_pool_replicas",
+    "Current replica count of an elastic RolePolicy role (an RL actor "
+    "pool), updated at every applied role resize (docs/rl.md)",
+    ["job_namespace", "job", "replica_type"])
+actor_preemptions = REGISTRY.counter(
+    "tpu_operator_actor_preemptions_total",
+    "Evict-class (non-barrier) replicas evicted without a "
+    "save-before-evict barrier, by reason (health|chaos|manual): the "
+    "disruptions the learner gang is supposed to ride out (docs/rl.md)",
+    ["job_namespace", "reason"])
 gang_resizes = REGISTRY.counter(
     "tpu_operator_gang_resizes_total",
     "Elastic gang resizes applied by the control plane, by direction "

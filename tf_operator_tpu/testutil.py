@@ -68,15 +68,20 @@ def new_tpujob(worker: int = 0,
                chief: int = 0,
                evaluator: int = 0,
                master: int = 0,
+               actor: int = 0,
                name: str = TEST_JOB_NAME,
                namespace: str = TEST_NAMESPACE,
                command: Optional[List[str]] = None,
                accelerator: str = "") -> TPUJob:
-    """Builder covering the reference's NewTFJob* matrix (testutil/tfjob.go)."""
+    """Builder covering the reference's NewTFJob* matrix (testutil/tfjob.go).
+
+    ``actor`` adds a bare actor replica spec (docs/rl.md); attach a
+    RolePolicy to it yourself — the builder stamps none so role-policy
+    defaults stay byte-identical to a policy-free job."""
     specs: Dict[str, ReplicaSpec] = {}
     for rtype, n in ((ReplicaType.WORKER, worker), (ReplicaType.PS, ps),
                      (ReplicaType.CHIEF, chief), (ReplicaType.EVALUATOR, evaluator),
-                     (ReplicaType.MASTER, master)):
+                     (ReplicaType.MASTER, master), (ReplicaType.ACTOR, actor)):
         if n > 0:
             specs[rtype] = new_replica_spec(n, command=command)
     job = TPUJob(
